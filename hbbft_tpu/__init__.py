@@ -1,0 +1,54 @@
+"""hbbft_tpu — a TPU-native (JAX/XLA/Pallas) HoneyBadgerBFT framework.
+
+A brand-new implementation of the capabilities of the Rust consensus library
+``yangl1996/hbbft`` (fork of ``poanetwork/hbbft``): a sans-I/O, deterministic
+stack of asynchronous BFT consensus state machines —
+
+- ``protocols.broadcast.Broadcast`` — Bracha reliable broadcast with GF(2^8)
+  Reed–Solomon erasure coding and SHA3/Merkle commitments
+  (reference: ``src/broadcast/broadcast.rs :: Broadcast``),
+- ``protocols.binary_agreement.BinaryAgreement`` — Mostéfaoui et al. ABA with a
+  BLS threshold-signature common coin
+  (reference: ``src/binary_agreement/binary_agreement.rs``),
+- ``protocols.subset.Subset`` — asynchronous common subset (ACS)
+  (reference: ``src/subset/subset.rs``),
+- ``protocols.honey_badger.HoneyBadger`` — epochs with TPKE-encrypted
+  contributions (reference: ``src/honey_badger/honey_badger.rs``),
+- ``protocols.dynamic_honey_badger`` / ``protocols.sync_key_gen`` — dynamic
+  membership via on-line DKG,
+- ``protocols.queueing_honey_badger`` — transaction queueing.
+
+The hot per-epoch math (RS encode/reconstruct, keccak, BLS/TPKE share ops)
+lives in ``ops/`` as batched jnp/Pallas kernels that vmap over
+(node × instance × epoch); ``parallel/`` holds the dense-array bulk-synchronous
+simulator that drives all N nodes through one device step per communication
+round under ``shard_map``; ``sim/`` holds the object-mode deterministic
+``VirtualNet`` harness with adversaries (reference: ``tests/net/``).
+
+The reference is sans-I/O: every algorithm consumes inputs/messages and
+returns a ``Step``; the caller owns the event loop.  We keep that contract
+exactly (``traits.ConsensusProtocol``) so the two execution modes — object
+mode and batched array mode — are interchangeable and cross-checkable.
+"""
+
+from hbbft_tpu.traits import (
+    ConsensusProtocol,
+    Step,
+    Target,
+    TargetedMessage,
+)
+from hbbft_tpu.netinfo import NetworkInfo
+from hbbft_tpu.fault_log import Fault, FaultKind, FaultLog
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ConsensusProtocol",
+    "Step",
+    "Target",
+    "TargetedMessage",
+    "NetworkInfo",
+    "Fault",
+    "FaultKind",
+    "FaultLog",
+]

@@ -1,0 +1,14 @@
+"""Threshold cryptography for the protocol stack.
+
+The reference delegates all crypto to the external ``threshold_crypto`` crate
+(BLS12-381 threshold signatures + TPKE threshold encryption over
+``pairing``/``ff``; SURVEY §2.2).  This package provides:
+
+- ``bls12_381`` — the curve: Fp/Fp2/Fp6/Fp12 tower, G1/G2, optimal ate
+  pairing, hash-to-G2.  Pure-Python ints (ground truth / CPU path).
+- ``tc`` — a ``threshold_crypto``-compatible API surface
+  (``SecretKeySet``/``PublicKeySet``/``Poly``/``BivarPoly``/``Ciphertext``/…)
+  so the protocol layer never touches curve internals.  The batched jnp
+  backend slots in behind the same API (the ``backend="jax"`` provider
+  boundary named by BASELINE.json's north star).
+"""

@@ -1,0 +1,107 @@
+"""Byzantine-fault evidence accumulation.
+
+Mirrors the reference's ``src/fault_log.rs`` (``Fault``, ``FaultLog``,
+``FaultKind``): protocols never panic on misbehaving peers — they record the
+evidence in the ``Step`` they return and carry on.  The caller decides what to
+do with the log (tests assert on it; a real deployment might slash).
+
+The reference splits fault kinds into per-module enums in newer versions; we
+keep one flat string-flavored enum for simplicity but preserve every variant
+name a protocol needs.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Hashable, Iterable, List
+
+
+class FaultKind(enum.Enum):
+    """Why a node was logged as faulty.
+
+    Variant set follows the reference's per-protocol fault enums
+    (``src/fault_log.rs :: FaultKind`` and the per-module enums that replaced
+    it upstream).
+    """
+
+    # broadcast
+    InvalidProof = "broadcast: Value/Echo carried an invalid Merkle proof"
+    MultipleValues = "broadcast: received multiple Values from the proposer"
+    MultipleEchos = "broadcast: received multiple Echos from a node"
+    MultipleReadys = "broadcast: received multiple Readys from a node"
+    NotAProposer = "broadcast: Value message from a node that is not the proposer"
+    UnknownSender = "message from a node that is not on the network"
+    # binary agreement
+    DuplicateBVal = "binary_agreement: duplicate BVal from a node"
+    DuplicateAux = "binary_agreement: duplicate Aux from a node"
+    MultipleConf = "binary_agreement: multiple Conf from a node"
+    MultipleTerm = "binary_agreement: multiple Term from a node"
+    AgreementEpochMismatch = "binary_agreement: message for an impossible epoch"
+    # threshold sign / decrypt
+    UnexpectedSignatureShare = "threshold_sign: share before the document was set"
+    InvalidSignatureShare = "threshold_sign: invalid signature share"
+    MultipleSignatureShares = "threshold_sign: multiple shares from a node"
+    UnexpectedDecryptionShare = "threshold_decrypt: share before ciphertext set"
+    InvalidDecryptionShare = "threshold_decrypt: invalid decryption share"
+    MultipleDecryptionShares = "threshold_decrypt: multiple shares from a node"
+    # honey badger
+    InvalidCiphertext = "honey_badger: proposed an invalid ciphertext"
+    BatchDeserializationFailed = "honey_badger: contribution failed to deserialize"
+    UnexpectedHbMessage = "honey_badger: message for an epoch outside the window"
+    DecryptionFailed = "honey_badger: threshold decryption failed"
+    # subset
+    InvalidSubsetMessage = "subset: message for an unknown proposer"
+    # dynamic honey badger / key gen
+    InvalidVoteSignature = "dynamic_honey_badger: invalid vote signature"
+    InvalidKeyGenMessage = "dynamic_honey_badger: invalid Part/Ack"
+    UnexpectedKeyGenPart = "dynamic_honey_badger: Part from a non-candidate"
+    InvalidPart = "sync_key_gen: invalid Part (bad commitment/row)"
+    InvalidAck = "sync_key_gen: invalid Ack (bad value)"
+    EchoHashConflict = "broadcast: EchoHash conflicts with a full Echo"
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One piece of evidence: ``node_id`` did ``kind``.
+
+    Reference: ``src/fault_log.rs :: Fault``.
+    """
+
+    node_id: Hashable
+    kind: FaultKind
+
+    def __repr__(self) -> str:  # keep logs short
+        return f"Fault({self.node_id!r}, {self.kind.name})"
+
+
+@dataclass
+class FaultLog:
+    """An append-only list of :class:`Fault` entries.
+
+    Reference: ``src/fault_log.rs :: FaultLog``.
+    """
+
+    faults: List[Fault] = field(default_factory=list)
+
+    @classmethod
+    def init(cls, node_id: Hashable, kind: FaultKind) -> "FaultLog":
+        return cls([Fault(node_id, kind)])
+
+    def append(self, node_id: Hashable, kind: FaultKind) -> None:
+        self.faults.append(Fault(node_id, kind))
+
+    def extend(self, other: "FaultLog") -> None:
+        self.faults.extend(other.faults)
+
+    def is_empty(self) -> bool:
+        return not self.faults
+
+    def __len__(self) -> int:
+        return len(self.faults)
+
+    def __iter__(self):
+        return iter(self.faults)
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)

@@ -1,0 +1,116 @@
+// Keccak-f[1600] / SHA3-256 CPU oracle (C ABI, loaded via ctypes).
+//
+// Ground truth for hbbft_tpu/ops/keccak.py (the reference hashes Merkle
+// leaves and the common-coin signature with SHA3 via `tiny-keccak`;
+// src/broadcast/merkle.rs). Constants derived from the FIPS-202 LFSR, same
+// as the jnp implementation, so a transcription error cannot hide in both.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+uint64_t kRC[24];
+int kRot[5][5];  // rot[x][y]
+bool kInit = false;
+
+int rc_bit(int t) {
+  t %= 255;
+  if (t == 0) return 1;
+  int R = 1;
+  for (int i = 1; i <= t; ++i) {
+    R <<= 1;
+    if (R & 0x100) R ^= 0x171;
+  }
+  return R & 1;
+}
+
+void init_tables() {
+  if (kInit) return;
+  for (int i = 0; i < 24; ++i) {
+    uint64_t rc = 0;
+    for (int j = 0; j < 7; ++j)
+      if (rc_bit(7 * i + j)) rc |= 1ULL << ((1 << j) - 1);
+    kRC[i] = rc;
+  }
+  int x = 1, y = 0;
+  kRot[0][0] = 0;
+  for (int t = 0; t < 24; ++t) {
+    kRot[x][y] = ((t + 1) * (t + 2) / 2) % 64;
+    int nx = y, ny = (2 * x + 3 * y) % 5;
+    x = nx;
+    y = ny;
+  }
+  kInit = true;
+}
+
+inline uint64_t rotl(uint64_t v, int s) {
+  return s == 0 ? v : (v << s) | (v >> (64 - s));
+}
+
+// state[5*y + x] = A[x][y]
+void keccak_f(uint64_t* s) {
+  init_tables();
+  uint64_t B[25], C[5], D[5];
+  for (int rnd = 0; rnd < 24; ++rnd) {
+    for (int x = 0; x < 5; ++x)
+      C[x] = s[x] ^ s[x + 5] ^ s[x + 10] ^ s[x + 15] ^ s[x + 20];
+    for (int x = 0; x < 5; ++x)
+      D[x] = C[(x + 4) % 5] ^ rotl(C[(x + 1) % 5], 1);
+    for (int y = 0; y < 5; ++y)
+      for (int x = 0; x < 5; ++x) s[5 * y + x] ^= D[x];
+    for (int y = 0; y < 5; ++y)
+      for (int x = 0; x < 5; ++x) {
+        int nx = y, ny = (2 * x + 3 * y) % 5;
+        B[5 * ny + nx] = rotl(s[5 * y + x], kRot[x][y]);
+      }
+    for (int y = 0; y < 5; ++y)
+      for (int x = 0; x < 5; ++x)
+        s[5 * y + x] =
+            B[5 * y + x] ^ (~B[5 * y + (x + 1) % 5] & B[5 * y + (x + 2) % 5]);
+    s[0] ^= kRC[rnd];
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void hbbft_keccak_f1600(uint64_t* state) { keccak_f(state); }
+
+void hbbft_sha3_256(const uint8_t* data, int64_t len, uint8_t* out) {
+  const int rate = 136;
+  uint64_t s[25];
+  std::memset(s, 0, sizeof(s));
+  int64_t off = 0;
+  while (len - off >= rate) {
+    for (int i = 0; i < rate / 8; ++i) {
+      uint64_t lane;
+      std::memcpy(&lane, data + off + 8 * i, 8);  // little-endian host assumed
+      s[i] ^= lane;
+    }
+    keccak_f(s);
+    off += rate;
+  }
+  uint8_t block[136];
+  std::memset(block, 0, sizeof(block));
+  std::memcpy(block, data + off, len - off);
+  block[len - off] ^= 0x06;
+  block[rate - 1] ^= 0x80;
+  for (int i = 0; i < rate / 8; ++i) {
+    uint64_t lane;
+    std::memcpy(&lane, block + 8 * i, 8);
+    s[i] ^= lane;
+  }
+  keccak_f(s);
+  std::memcpy(out, s, 32);
+}
+
+// Batched: n messages, each msg_len bytes, contiguous.
+void hbbft_sha3_256_batch(const uint8_t* data, int64_t n, int64_t msg_len,
+                          uint8_t* out) {
+  for (int64_t i = 0; i < n; ++i)
+    hbbft_sha3_256(data + i * msg_len, msg_len, out + i * 32);
+}
+
+}  // extern "C"

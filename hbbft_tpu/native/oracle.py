@@ -1,0 +1,159 @@
+"""ctypes loader for the C++ CPU oracle library."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB = os.path.join(_DIR, "libhbbft_native.so")
+
+_oracle: Optional["NativeOracle"] = None
+
+
+def _build() -> None:
+    subprocess.run(
+        ["make", "-s"], cwd=_DIR, check=True, capture_output=True, text=True
+    )
+
+
+class NativeOracle:
+    """Thin typed wrapper over the C ABI in gf256.cpp / keccak.cpp."""
+
+    def __init__(self):
+        if not os.path.exists(_LIB) or (
+            os.path.getmtime(_LIB)
+            < max(
+                os.path.getmtime(os.path.join(_DIR, f))
+                for f in ("gf256.cpp", "keccak.cpp")
+            )
+        ):
+            _build()
+        lib = ctypes.CDLL(_LIB)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.hbbft_gf_mul_bytes.argtypes = [u8p, u8p, u8p, ctypes.c_int64]
+        lib.hbbft_gf_matmul.argtypes = [u8p, u8p, u8p] + [ctypes.c_int] * 3
+        lib.hbbft_gf_invert.argtypes = [u8p, u8p, ctypes.c_int]
+        lib.hbbft_gf_invert.restype = ctypes.c_int
+        lib.hbbft_rs_matrix.argtypes = [ctypes.c_int, ctypes.c_int, u8p]
+        lib.hbbft_rs_matrix.restype = ctypes.c_int
+        lib.hbbft_rs_encode.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int64, u8p,
+        ]
+        lib.hbbft_rs_encode.restype = ctypes.c_int
+        lib.hbbft_rs_reconstruct.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int64, u8p, u8p,
+        ]
+        lib.hbbft_rs_reconstruct.restype = ctypes.c_int
+        lib.hbbft_keccak_f1600.argtypes = [u64p]
+        lib.hbbft_sha3_256.argtypes = [u8p, ctypes.c_int64, u8p]
+        lib.hbbft_sha3_256_batch.argtypes = [
+            u8p, ctypes.c_int64, ctypes.c_int64, u8p,
+        ]
+        self._lib = lib
+
+    @staticmethod
+    def _p(a: np.ndarray):
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+    def gf_mul(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        a = np.ascontiguousarray(a, dtype=np.uint8)
+        b = np.ascontiguousarray(b, dtype=np.uint8)
+        out = np.empty_like(a)
+        self._lib.hbbft_gf_mul_bytes(self._p(a), self._p(b), self._p(out), a.size)
+        return out
+
+    def gf_matmul(self, A: np.ndarray, B: np.ndarray) -> np.ndarray:
+        A = np.ascontiguousarray(A, dtype=np.uint8)
+        B = np.ascontiguousarray(B, dtype=np.uint8)
+        r, k = A.shape
+        k2, c = B.shape
+        assert k == k2
+        out = np.empty((r, c), dtype=np.uint8)
+        self._lib.hbbft_gf_matmul(self._p(A), self._p(B), self._p(out), r, k, c)
+        return out
+
+    def gf_invert(self, M: np.ndarray) -> np.ndarray:
+        M = np.ascontiguousarray(M, dtype=np.uint8)
+        n = M.shape[0]
+        out = np.empty((n, n), dtype=np.uint8)
+        rc = self._lib.hbbft_gf_invert(self._p(M), self._p(out), n)
+        if rc != 0:
+            raise np.linalg.LinAlgError("singular")
+        return out
+
+    def rs_matrix(self, data: int, total: int) -> np.ndarray:
+        out = np.empty((total, data), dtype=np.uint8)
+        rc = self._lib.hbbft_rs_matrix(data, total, self._p(out))
+        if rc != 0:
+            raise ValueError("bad rs dims")
+        return out
+
+    def rs_encode(self, data_shards: np.ndarray, total: int) -> np.ndarray:
+        data_shards = np.ascontiguousarray(data_shards, dtype=np.uint8)
+        k, B = data_shards.shape
+        shards = np.zeros((total, B), dtype=np.uint8)
+        shards[:k] = data_shards
+        rc = self._lib.hbbft_rs_encode(k, total, B, self._p(shards))
+        if rc != 0:
+            raise ValueError("encode failed")
+        return shards
+
+    def rs_reconstruct(
+        self, data: int, shards: Sequence[Optional[bytes]]
+    ) -> List[bytes]:
+        total = len(shards)
+        present = np.array(
+            [1 if s is not None else 0 for s in shards], dtype=np.uint8
+        )
+        if int(present.sum()) < data:
+            raise ValueError("too few shards")
+        shard_len = len(next(s for s in shards if s is not None))
+        buf = np.zeros((total, shard_len), dtype=np.uint8)
+        for i, s in enumerate(shards):
+            if s is not None:
+                buf[i] = np.frombuffer(s, dtype=np.uint8)
+        rc = self._lib.hbbft_rs_reconstruct(
+            data, total, shard_len, self._p(buf), self._p(present)
+        )
+        if rc == -1:
+            raise ValueError("too few shards")
+        if rc != 0:
+            raise ValueError("reconstruct failed")
+        return [buf[i].tobytes() for i in range(total)]
+
+    def keccak_f1600(self, state: np.ndarray) -> np.ndarray:
+        state = np.ascontiguousarray(state, dtype=np.uint64).copy()
+        assert state.shape == (25,)
+        self._lib.hbbft_keccak_f1600(
+            state.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+        )
+        return state
+
+    def sha3_256(self, data: bytes) -> bytes:
+        arr = np.frombuffer(bytes(data), dtype=np.uint8)
+        if arr.size == 0:
+            arr = np.zeros(1, dtype=np.uint8)  # valid pointer; len passed as 0
+        out = np.empty(32, dtype=np.uint8)
+        self._lib.hbbft_sha3_256(self._p(arr), len(data), self._p(out))
+        return out.tobytes()
+
+    def sha3_256_batch(self, msgs: np.ndarray) -> np.ndarray:
+        msgs = np.ascontiguousarray(msgs, dtype=np.uint8)
+        n, L = msgs.shape
+        out = np.empty((n, 32), dtype=np.uint8)
+        self._lib.hbbft_sha3_256_batch(self._p(msgs), n, L, self._p(out))
+        return out
+
+
+def get_oracle() -> NativeOracle:
+    """Build (if needed) and return the singleton oracle."""
+    global _oracle
+    if _oracle is None:
+        _oracle = NativeOracle()
+    return _oracle

@@ -1,0 +1,11 @@
+"""Batched TPU kernels (jnp/Pallas) for the protocol hot path, with host
+(numpy) oracles.
+
+- ``gf256`` — GF(2^8) arithmetic (poly 0x11D, generator 2, matching the
+  ``reed-solomon-erasure`` crate's field) and the bit-plane lowering that
+  turns GF(2^8) matmuls into single MXU int8 matmuls.
+- ``rs`` — systematic Vandermonde Reed–Solomon erasure coding
+  (encode/reconstruct/verify) used by reliable broadcast.
+- ``keccak`` — batched keccak-f[1600] / SHA3-256 on uint32 lane halves.
+- ``merkle`` — Merkle trees over SHA3-256 digests with batched build/verify.
+"""

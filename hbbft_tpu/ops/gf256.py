@@ -1,0 +1,198 @@
+"""GF(2^8) arithmetic — host tables and the TPU bit-plane lowering.
+
+Field: GF(2^8) with reducing polynomial x^8+x^4+x^3+x^2+1 (0x11D) and
+generator 2 — the same field as the ``reed-solomon-erasure`` crate the
+reference links for RBC shard coding (reference:
+``src/broadcast/broadcast.rs`` uses ``ReedSolomon::new(data, parity)``).
+
+Two execution paths:
+
+1. **Host (numpy) oracle** — log/exp tables, used for matrix construction,
+   inversion (data-dependent, tiny) and bit-exact tests.
+2. **Device (jnp) bit-plane path** — multiplication by a *constant* GF(2^8)
+   element is linear over GF(2), so a GF(2^8) matrix–vector product
+   ``out_j = XOR_k mul(M[j,k], d_k)`` lowers to ONE binary matmul:
+   expand bytes to bits, multiply by an 8×-expanded 0/1 matrix with an int8
+   MXU matmul, take parity (``& 1``), repack bits to bytes.  No gathers, no
+   scalar loops — exactly the shape XLA tiles onto the MXU.  This is the
+   whole RS encode/decode story on TPU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+GF_POLY = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1
+GF_GEN = 2
+
+# ---------------------------------------------------------------------------
+# Host tables
+# ---------------------------------------------------------------------------
+
+
+def _build_tables():
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= GF_POLY
+    for i in range(255, 512):
+        exp[i] = exp[i - 255]
+    return exp, log
+
+
+GF_EXP, GF_LOG = _build_tables()
+
+# Full 256×256 multiplication table (64 KiB) — handy for vectorized host code.
+_A = np.arange(256)
+_MUL_TABLE = np.zeros((256, 256), dtype=np.uint8)
+_nz = _A[1:]
+_MUL_TABLE[1:, 1:] = GF_EXP[(GF_LOG[_nz][:, None] + GF_LOG[_nz][None, :]) % 255]
+
+
+def gf_mul(a, b):
+    """Elementwise GF(2^8) multiply (numpy, any broadcastable uint8 shapes)."""
+    a = np.asarray(a, dtype=np.uint8)
+    b = np.asarray(b, dtype=np.uint8)
+    return _MUL_TABLE[a, b]
+
+
+def gf_inv(a):
+    a = np.asarray(a)
+    if np.any(a == 0):
+        raise ZeroDivisionError("GF(2^8) inverse of 0")
+    return GF_EXP[255 - GF_LOG[a]]
+
+
+def gf_div(a, b):
+    return gf_mul(a, gf_inv(b))
+
+
+def gf_pow(a: int, n: int) -> int:
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(GF_EXP[(int(GF_LOG[a]) * n) % 255])
+
+
+def gf_matmul_np(A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    """GF(2^8) matrix product (host oracle). A: (r,k), B: (k,c) → (r,c)."""
+    A = np.asarray(A, dtype=np.uint8)
+    B = np.asarray(B, dtype=np.uint8)
+    r, k = A.shape
+    k2, c = B.shape
+    assert k == k2
+    out = np.zeros((r, c), dtype=np.uint8)
+    for i in range(k):  # k is small (≤ N); columns vectorized
+        out ^= _MUL_TABLE[A[:, i][:, None], B[i][None, :]]
+    return out
+
+
+def gf_inv_matrix_np(M: np.ndarray) -> np.ndarray:
+    """Invert a square GF(2^8) matrix by Gauss–Jordan elimination (host)."""
+    M = np.asarray(M, dtype=np.uint8)
+    n = M.shape[0]
+    assert M.shape == (n, n)
+    aug = np.concatenate([M.copy(), np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        piv = col + int(np.argmax(aug[col:, col] != 0))
+        if aug[piv, col] == 0:
+            raise np.linalg.LinAlgError("singular GF(2^8) matrix")
+        if piv != col:
+            aug[[col, piv]] = aug[[piv, col]]
+        aug[col] = gf_mul(aug[col], gf_inv(aug[col, col]))
+        mask = aug[:, col].copy()
+        mask[col] = 0
+        aug ^= _MUL_TABLE[mask[:, None], aug[col][None, :]]
+    return aug[:, n:].copy()
+
+
+def vandermonde(rows: int, cols: int) -> np.ndarray:
+    """V[r, c] = r^c in GF(2^8) — the ``reed-solomon-erasure`` construction."""
+    V = np.zeros((rows, cols), dtype=np.uint8)
+    for r in range(rows):
+        for c in range(cols):
+            V[r, c] = gf_pow(r, c)
+    return V
+
+
+# ---------------------------------------------------------------------------
+# Bit-plane lowering (device path)
+# ---------------------------------------------------------------------------
+
+
+def gf_matrix_to_bits(M: np.ndarray) -> np.ndarray:
+    """Expand a GF(2^8) matrix (r, k) to its GF(2) bit matrix (k*8, r*8).
+
+    Layout: ``A[k*8 + i, j*8 + b]`` = bit ``b`` of ``gf_mul(M[j, k], 1 << i)``
+    (bits LSB-first), so that for data bits ``D`` of shape (..., k*8):
+    ``out_bits = (D @ A) & 1`` gives (..., r*8) with
+    ``out_bits[..., j*8 + b]`` = bit b of ``XOR_k gf_mul(M[j,k], d_k)``.
+    """
+    M = np.asarray(M, dtype=np.uint8)
+    r, k = M.shape
+    powers = np.left_shift(1, np.arange(8)).astype(np.uint8)  # 1<<i
+    # prod[j, kk, i] = gf_mul(M[j, kk], 1<<i)
+    prod = _MUL_TABLE[M[:, :, None], powers[None, None, :]]
+    # bits[j, kk, i, b]
+    bits = (prod[..., None] >> np.arange(8)) & 1
+    # → (kk, i, j, b) → (k*8, r*8)
+    A = bits.transpose(1, 2, 0, 3).reshape(k * 8, r * 8)
+    return A.astype(np.int8)
+
+
+# jnp helpers — imported lazily so the host oracle works without jax.
+
+
+def bytes_to_bits(x):
+    """uint8 (..., K) → int8 bits (..., K*8), LSB-first."""
+    import jax.numpy as jnp
+
+    bits = (x[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+    return bits.reshape(*x.shape[:-1], x.shape[-1] * 8).astype(jnp.int8)
+
+
+def bits_to_bytes(bits):
+    """int (..., K*8) bits → uint8 (..., K), LSB-first."""
+    import jax.numpy as jnp
+
+    b = bits.reshape(*bits.shape[:-1], bits.shape[-1] // 8, 8).astype(jnp.uint8)
+    weights = jnp.left_shift(jnp.uint8(1), jnp.arange(8, dtype=jnp.uint8))
+    return (b * weights).sum(axis=-1).astype(jnp.uint8)
+
+
+def gf_apply_bitmatrix(data, bitmat):
+    """Apply a constant GF(2^8) matrix to byte data on device.
+
+    data: uint8 (..., B, k) — B byte-positions × k input symbols.
+    bitmat: int8 (k*8, r*8) from :func:`gf_matrix_to_bits` (constant).
+    Returns uint8 (..., B, r).
+
+    The contraction is an int8×int8→int32 matmul — on TPU this is a single
+    MXU pass; the bit (un)packing fuses into it as elementwise ops.
+    """
+    import jax.numpy as jnp
+
+    dbits = bytes_to_bits(data)  # (..., B, k*8)
+    obits = jnp.matmul(dbits, bitmat, preferred_element_type=jnp.int32) & 1
+    return bits_to_bytes(obits)
+
+
+def gf_mul_jnp(a, b):
+    """Elementwise GF(2^8) multiply on device via log/exp gathers.
+
+    For data×data products (both operands runtime values).  Constant-matrix
+    products should use :func:`gf_apply_bitmatrix` instead.
+    """
+    import jax.numpy as jnp
+
+    exp = jnp.asarray(GF_EXP)
+    log = jnp.asarray(GF_LOG)
+    r = exp[(log[a] + log[b]) % 255]
+    nz = (a != 0) & (b != 0)
+    return jnp.where(nz, r, 0).astype(jnp.uint8)

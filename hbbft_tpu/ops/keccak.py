@@ -1,0 +1,206 @@
+"""Batched keccak-f[1600] and SHA3-256 in jnp.
+
+The reference's Merkle commitments hash RBC shards with SHA3-256 (reference:
+``src/broadcast/merkle.rs`` digests via ``tiny-keccak``), and the common coin
+is the hash of the combined threshold signature.  On TPU we need *many*
+digests per protocol round (N nodes × N instances × shards), so the permutation
+is written to batch over arbitrary leading axes.
+
+TPUs have no native 64-bit integer path, so every 64-bit lane is a pair of
+uint32 arrays ``(hi, lo)``; rotations/xors are expressed on the halves.  The
+state is ``(..., 25)`` with flat index ``5*y + x`` (the byte-serialization
+order), i.e. ``state[5y+x] = A[x,y]`` in the Keccak reference's coordinates.
+
+Round constants and rotation offsets are derived programmatically from the
+spec (LFSR / triangular numbers) rather than transcribed tables.
+
+Host oracle: ``hashlib.sha3_256`` (tests assert bit-exactness against it).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Spec-derived constants
+# ---------------------------------------------------------------------------
+
+
+def _rc_bit(t: int) -> int:
+    if t % 255 == 0:
+        return 1
+    R = 1
+    for _ in range(1, t % 255 + 1):
+        R <<= 1
+        if R & 0x100:
+            R ^= 0x171
+    return R & 1
+
+
+def _round_constants():
+    rcs = []
+    for i in range(24):
+        rc = 0
+        for j in range(7):
+            rc |= _rc_bit(7 * i + j) << ((1 << j) - 1)
+        rcs.append(rc)
+    return rcs
+
+
+ROUND_CONSTANTS = _round_constants()
+
+
+def _rho_pi_tables():
+    """Per-target-lane source index and rotation for the fused ρ∘π step.
+
+    ρ offsets from the triangular-number walk: start (x,y)=(1,0);
+    r[x,y] = (t+1)(t+2)/2 mod 64; step (x,y) ← (y, 2x+3y).
+    π: A'[x', y'] = A[x, y] with x' = y, y' = (2x+3y) mod 5, fused so
+    ``out[tgt] = rotl(state[src[tgt]], rot[tgt])``.
+    """
+    r = np.zeros((5, 5), dtype=np.int64)  # r[x, y]
+    x, y = 1, 0
+    for t in range(24):
+        r[x, y] = ((t + 1) * (t + 2) // 2) % 64
+        x, y = y, (2 * x + 3 * y) % 5
+    src = np.zeros(25, dtype=np.int32)
+    rot = np.zeros(25, dtype=np.int32)
+    for yt in range(5):
+        for xt in range(5):
+            tgt = 5 * yt + xt
+            sx = (xt + 3 * yt) % 5  # source x
+            sy = xt  # source y
+            src[tgt] = 5 * sy + sx
+            rot[tgt] = r[sx, sy]
+    return src, rot
+
+
+_PI_SRC, _PI_ROT = _rho_pi_tables()
+
+RATE_BYTES = 136  # SHA3-256: rate 1088 bits, capacity 512
+DIGEST_BYTES = 32
+
+
+# ---------------------------------------------------------------------------
+# 64-bit-as-two-uint32 helpers
+# ---------------------------------------------------------------------------
+
+
+def _rotl64(hi, lo, s):
+    """Rotate-left (hi, lo) by per-element shifts ``s`` (0..63, array ok)."""
+    import jax.numpy as jnp
+
+    s = jnp.asarray(s, dtype=jnp.uint32)
+    swap = (s >= 32) & (s < 64)
+    s32 = jnp.where(swap, s - 32, s)
+    a, b = jnp.where(swap, lo, hi), jnp.where(swap, hi, lo)
+    # now rotate (a, b) left by s32 in [0, 32)
+    nz = s32 > 0
+    inv = jnp.where(nz, 32 - s32, 1)  # avoid >>32 UB when s32 == 0
+    hi_out = jnp.where(nz, (a << s32) | (b >> inv), a)
+    lo_out = jnp.where(nz, (b << s32) | (a >> inv), b)
+    return hi_out.astype(jnp.uint32), lo_out.astype(jnp.uint32)
+
+
+def keccak_f1600(hi, lo):
+    """One keccak-f[1600] permutation, batched.
+
+    hi, lo: uint32 arrays of shape (..., 25).
+    """
+    import jax.numpy as jnp
+
+    src = jnp.asarray(_PI_SRC)
+    rot = jnp.asarray(_PI_ROT)
+    rcs_hi = jnp.asarray([(c >> 32) & 0xFFFFFFFF for c in ROUND_CONSTANTS],
+                         dtype=jnp.uint32)
+    rcs_lo = jnp.asarray([c & 0xFFFFFFFF for c in ROUND_CONSTANTS],
+                         dtype=jnp.uint32)
+
+    def grid(h):
+        return h.reshape(*h.shape[:-1], 5, 5)  # [..., y, x]
+
+    def flat(h):
+        return h.reshape(*h.shape[:-2], 25)
+
+    for rnd in range(24):
+        # θ — column parities
+        Th, Tl = grid(hi), grid(lo)
+        Ch = Th[..., 0, :] ^ Th[..., 1, :] ^ Th[..., 2, :] ^ Th[..., 3, :] ^ Th[..., 4, :]
+        Cl = Tl[..., 0, :] ^ Tl[..., 1, :] ^ Tl[..., 2, :] ^ Tl[..., 3, :] ^ Tl[..., 4, :]
+        C1h, C1l = _rotl64(jnp.roll(Ch, -1, axis=-1), jnp.roll(Cl, -1, axis=-1), 1)
+        Dh = jnp.roll(Ch, 1, axis=-1) ^ C1h
+        Dl = jnp.roll(Cl, 1, axis=-1) ^ C1l
+        Th = Th ^ Dh[..., None, :]
+        Tl = Tl ^ Dl[..., None, :]
+        hi, lo = flat(Th), flat(Tl)
+        # ρ ∘ π — gather + per-lane rotate
+        hi, lo = _rotl64(hi[..., src], lo[..., src], rot)
+        # χ — row nonlinearity
+        Th, Tl = grid(hi), grid(lo)
+        Th = Th ^ (~jnp.roll(Th, -1, axis=-1) & jnp.roll(Th, -2, axis=-1))
+        Tl = Tl ^ (~jnp.roll(Tl, -1, axis=-1) & jnp.roll(Tl, -2, axis=-1))
+        hi, lo = flat(Th), flat(Tl)
+        # ι
+        hi = hi.at[..., 0].set(hi[..., 0] ^ rcs_hi[rnd])
+        lo = lo.at[..., 0].set(lo[..., 0] ^ rcs_lo[rnd])
+    return hi, lo
+
+
+def _bytes_to_lanes(block):
+    """uint8 (..., 8*L) little-endian → (hi, lo) uint32 (..., L)."""
+    import jax.numpy as jnp
+
+    b = block.reshape(*block.shape[:-1], block.shape[-1] // 8, 8).astype(jnp.uint32)
+    w = jnp.left_shift(jnp.uint32(1), jnp.arange(4, dtype=jnp.uint32) * 8)
+    lo = (b[..., :4] * w).sum(axis=-1).astype(jnp.uint32)
+    hi = (b[..., 4:] * w).sum(axis=-1).astype(jnp.uint32)
+    return hi, lo
+
+
+def _lanes_to_bytes(hi, lo):
+    """(hi, lo) uint32 (..., L) → uint8 (..., 8*L) little-endian."""
+    import jax.numpy as jnp
+
+    sh = jnp.arange(4, dtype=jnp.uint32) * 8
+    lo_b = (lo[..., None] >> sh) & 0xFF
+    hi_b = (hi[..., None] >> sh) & 0xFF
+    out = jnp.concatenate([lo_b, hi_b], axis=-1).astype(jnp.uint8)
+    return out.reshape(*hi.shape[:-1], hi.shape[-1] * 8)
+
+
+def sha3_256(data):
+    """Batched SHA3-256.  data: uint8 (..., L) with static L → (..., 32).
+
+    Pads per FIPS-202 (domain 0x06, final bit 0x80), absorbs at rate 136,
+    squeezes 32 bytes.  Bit-exact with ``hashlib.sha3_256``.
+    """
+    import jax.numpy as jnp
+
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    L = data.shape[-1]
+    nblocks = L // RATE_BYTES + 1
+    padded_len = nblocks * RATE_BYTES
+    pad = jnp.zeros((*data.shape[:-1], padded_len - L), dtype=jnp.uint8)
+    m = jnp.concatenate([data, pad], axis=-1)
+    m = m.at[..., L].set(m[..., L] ^ 0x06)
+    m = m.at[..., -1].set(m[..., -1] ^ 0x80)
+
+    batch_shape = data.shape[:-1]
+    hi = jnp.zeros((*batch_shape, 25), dtype=jnp.uint32)
+    lo = jnp.zeros((*batch_shape, 25), dtype=jnp.uint32)
+    for i in range(nblocks):
+        block = m[..., i * RATE_BYTES : (i + 1) * RATE_BYTES]
+        bhi, blo = _bytes_to_lanes(block)
+        hi = hi.at[..., : RATE_BYTES // 8].set(hi[..., : RATE_BYTES // 8] ^ bhi)
+        lo = lo.at[..., : RATE_BYTES // 8].set(lo[..., : RATE_BYTES // 8] ^ blo)
+        hi, lo = keccak_f1600(hi, lo)
+    return _lanes_to_bytes(hi[..., :4], lo[..., :4])
+
+
+def sha3_256_host(data: bytes) -> bytes:
+    """Host oracle — Python's built-in SHA3 (FIPS-202)."""
+    import hashlib
+
+    return hashlib.sha3_256(data).digest()

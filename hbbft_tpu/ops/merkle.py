@@ -1,0 +1,198 @@
+"""Merkle trees over SHA3-256 digests.
+
+Mirrors the reference's ``src/broadcast/merkle.rs`` (``MerkleTree::from_vec``,
+``Proof { value, index, root_hash, lemma }``): the RBC proposer commits to the
+N erasure-coded shards with a Merkle root; each ``Value``/``Echo`` message
+carries one shard plus its inclusion proof.
+
+Tree shape: leaves are ``sha3_256(value)``; at every level pairs hash to
+``sha3_256(left || right)`` and an odd trailing node is carried up unchanged.
+This exactly determines the root for any leaf count (no power-of-two padding),
+and gives ⌈log2⌉-length proofs.
+
+Host path: bytes + hashlib.  Device path: batched build over
+(... × n_leaves × leaf_bytes) arrays and batched proof verification, for the
+array-mode simulator.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from hbbft_tpu.ops.keccak import sha3_256_host
+
+Digest = bytes  # 32 bytes
+
+
+@dataclass(frozen=True)
+class Proof:
+    """Inclusion proof for ``value`` at ``index`` under ``root_hash``.
+
+    ``path`` lists (sibling_digest, sibling_on_left) from leaf level up;
+    levels where the node had no sibling (odd carry) are skipped.
+    Reference: ``src/broadcast/merkle.rs :: Proof``.
+    """
+
+    value: bytes
+    index: int
+    root_hash: Digest
+    path: Tuple[Tuple[Digest, bool], ...]
+
+    def validate(self, n_leaves: int) -> bool:
+        """Check the proof against its own root (and index bounds).
+
+        Reference: ``Proof::validate``.
+        """
+        if not 0 <= self.index < n_leaves:
+            return False
+        h = sha3_256_host(self.value)
+        idx, width = self.index, n_leaves
+        path = list(self.path)
+        while width > 1:
+            if (idx ^ 1) < width:  # this level has a sibling
+                if not path:
+                    return False
+                sibling, sib_left = path.pop(0)
+                if sib_left != (idx % 2 == 1):
+                    return False
+                h = (
+                    sha3_256_host(sibling + h)
+                    if sib_left
+                    else sha3_256_host(h + sibling)
+                )
+            idx //= 2
+            width = (width + 1) // 2
+        return not path and h == self.root_hash
+
+
+class MerkleTree:
+    """Reference: ``src/broadcast/merkle.rs :: MerkleTree``."""
+
+    def __init__(self, values: Sequence[bytes]):
+        if not values:
+            raise ValueError("MerkleTree needs at least one leaf")
+        self.values: List[bytes] = [bytes(v) for v in values]
+        self.levels: List[List[Digest]] = [
+            [sha3_256_host(v) for v in self.values]
+        ]
+        while len(self.levels[-1]) > 1:
+            prev = self.levels[-1]
+            nxt = []
+            for i in range(0, len(prev) - 1, 2):
+                nxt.append(sha3_256_host(prev[i] + prev[i + 1]))
+            if len(prev) % 2 == 1:
+                nxt.append(prev[-1])  # odd carry
+            self.levels.append(nxt)
+
+    @classmethod
+    def from_vec(cls, values: Sequence[bytes]) -> "MerkleTree":
+        return cls(values)
+
+    def root_hash(self) -> Digest:
+        return self.levels[-1][0]
+
+    def proof(self, index: int) -> Optional[Proof]:
+        if not 0 <= index < len(self.values):
+            return None
+        path = []
+        idx = index
+        for level in self.levels[:-1]:
+            sib = idx ^ 1
+            if sib < len(level):
+                path.append((level[sib], sib < idx))
+            idx //= 2
+        return Proof(
+            value=self.values[index],
+            index=index,
+            root_hash=self.root_hash(),
+            path=tuple(path),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Device (batched) path
+# ---------------------------------------------------------------------------
+
+
+def merkle_build_jax(leaves):
+    """Batched tree build.
+
+    leaves: uint8 (..., n, leaf_bytes) → (root (..., 32),
+    proof_digests (..., n, depth, 32), proof_mask (depth,) per-level
+    has-sibling bools per leaf as (..., n, depth) int8).
+
+    The per-level structure (odd carries) is static given n, so everything
+    jits to fixed shapes.  Proof layout matches :class:`Proof`: level order
+    leaf→root, missing-sibling levels masked out.
+    """
+    import jax.numpy as jnp
+
+    from hbbft_tpu.ops.keccak import sha3_256
+
+    n = leaves.shape[-2]
+    level = sha3_256(leaves)  # (..., n, 32)
+    depth = 0
+    w = n
+    while w > 1:
+        depth += 1
+        w = (w + 1) // 2
+
+    batch = leaves.shape[:-2]
+    proof = jnp.zeros((*batch, n, max(depth, 1), 32), dtype=jnp.uint8)
+    mask = jnp.zeros((n, max(depth, 1)), dtype=jnp.int8)
+
+    idx = list(range(n))  # leaf → current node position at this level
+    width = n
+    d = 0
+    while width > 1:
+        import numpy as _np
+
+        pos = _np.asarray(idx)
+        sib = pos ^ 1
+        has = sib < width
+        # record sibling digest for each original leaf
+        sib_digest = jnp.take(level, jnp.asarray(_np.where(has, sib, pos)), axis=-2)
+        proof = proof.at[..., :, d, :].set(
+            jnp.where(jnp.asarray(has)[..., None], sib_digest, 0)
+        )
+        mask = mask.at[:, d].set(jnp.asarray(has, dtype=jnp.int8))
+        # next level
+        pairs = width // 2
+        left = level[..., 0 : 2 * pairs : 2, :]
+        right = level[..., 1 : 2 * pairs : 2, :]
+        parents = sha3_256(jnp.concatenate([left, right], axis=-1))
+        if width % 2 == 1:
+            parents = jnp.concatenate([parents, level[..., -1:, :]], axis=-2)
+        level = parents
+        idx = [i // 2 for i in idx]
+        width = (width + 1) // 2
+        d += 1
+    root = level[..., 0, :]
+    return root, proof, mask
+
+
+def merkle_verify_jax(values, indices, roots, proofs, mask):
+    """Batched proof verification.
+
+    values: uint8 (..., leaf_bytes); indices: int32 (...,);
+    roots: (..., 32); proofs: (..., depth, 32); mask: (..., depth) int8.
+    Returns bool (...,).
+    """
+    import jax.numpy as jnp
+
+    from hbbft_tpu.ops.keccak import sha3_256
+
+    h = sha3_256(values)
+    idx = indices
+    depth = proofs.shape[-2]
+    for d in range(depth):
+        sib = proofs[..., d, :]
+        has = mask[..., d].astype(bool)
+        is_right = (idx % 2).astype(bool)  # we are the right child → sib on left
+        cat_l = jnp.concatenate([sib, h], axis=-1)
+        cat_r = jnp.concatenate([h, sib], axis=-1)
+        hashed = sha3_256(jnp.where(is_right[..., None], cat_l, cat_r))
+        h = jnp.where(has[..., None], hashed, h)
+        idx = idx // 2  # odd-carry nodes also halve their position per level
+    return jnp.all(h == roots, axis=-1)

@@ -1,0 +1,143 @@
+"""Systematic Vandermonde Reed–Solomon erasure coding over GF(2^8).
+
+Mirrors the semantics of the ``reed-solomon-erasure`` crate used by the
+reference's reliable broadcast (``src/broadcast/broadcast.rs :: send_shards``
+encodes a value into N shards: data = N−2f, parity = 2f; receivers
+``reconstruct`` from any ``data`` surviving shards and re-encode to verify the
+Merkle root).  Same construction as that crate (a Backblaze-style port):
+encode matrix = Vandermonde(total, data) normalised by the inverse of its top
+data×data block, so the first ``data`` rows are the identity (systematic).
+
+Host path: numpy tables.  Device path: constant-matrix application via the
+bit-plane MXU matmul in :mod:`hbbft_tpu.ops.gf256`, batched over arbitrary
+leading axes (node × instance × epoch).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hbbft_tpu.ops import gf256
+
+
+class ReedSolomon:
+    """``ReedSolomon::new(data_shards, parity_shards)`` equivalent.
+
+    ``parity_shards == 0`` degrades to the reference's ``Coding::Trivial``
+    (identity coding) used when f = 0.
+    """
+
+    def __init__(self, data_shards: int, parity_shards: int):
+        if data_shards < 1:
+            raise ValueError("data_shards must be >= 1")
+        if data_shards + parity_shards > 256:
+            raise ValueError("total shards must be <= 256 over GF(2^8)")
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        # Systematic encode matrix: top block identity, bottom parity rows.
+        V = gf256.vandermonde(self.total_shards, data_shards)
+        top_inv = gf256.gf_inv_matrix_np(V[:data_shards])
+        self.matrix = gf256.gf_matmul_np(V, top_inv)  # (total, data)
+        assert np.array_equal(
+            self.matrix[:data_shards], np.eye(data_shards, dtype=np.uint8)
+        )
+        self.parity_matrix = self.matrix[data_shards:]  # (parity, data)
+        self._parity_bits = gf256.gf_matrix_to_bits(self.parity_matrix)
+        self._decode_cache = {}
+
+    # ------------------------------------------------------------------ host
+    def encode_np(self, data: np.ndarray) -> np.ndarray:
+        """data (data_shards, B) uint8 → all shards (total_shards, B)."""
+        data = np.asarray(data, dtype=np.uint8)
+        assert data.shape[0] == self.data_shards
+        if self.parity_shards == 0:
+            return data.copy()
+        parity = gf256.gf_matmul_np(self.parity_matrix, data)
+        return np.concatenate([data, parity], axis=0)
+
+    def verify_np(self, shards: np.ndarray) -> bool:
+        """True iff parity shards are consistent with data shards."""
+        shards = np.asarray(shards, dtype=np.uint8)
+        return bool(np.array_equal(self.encode_np(shards[: self.data_shards]), shards))
+
+    def reconstruct_np(
+        self, shards: Sequence[Optional[bytes]]
+    ) -> List[bytes]:
+        """Fill in missing (None) shards; needs ≥ data_shards present.
+
+        Mirrors ``ReedSolomon::reconstruct(&mut Vec<Option<_>>)``.
+        """
+        present = [i for i, s in enumerate(shards) if s is not None]
+        if len(present) < self.data_shards:
+            raise ValueError(
+                f"too few shards: {len(present)} < {self.data_shards}"
+            )
+        if len(shards) != self.total_shards:
+            raise ValueError("wrong shard count")
+        shard_len = len(shards[present[0]])
+        if any(len(shards[i]) != shard_len for i in present):
+            raise ValueError("inconsistent shard lengths")
+        use = present[: self.data_shards]
+        dec = self._decode_matrix(tuple(use))  # (data, data) mapping use→data
+        sub = np.stack(
+            [np.frombuffer(shards[i], dtype=np.uint8) for i in use]
+        )  # (data, B)
+        data = gf256.gf_matmul_np(dec, sub)  # (data, B)
+        full = gf256.gf_matmul_np(self.matrix, data) if self.parity_shards else data
+        out: List[bytes] = []
+        for i in range(self.total_shards):
+            if shards[i] is not None:
+                out.append(bytes(shards[i]))
+            else:
+                out.append(full[i].tobytes())
+        return out
+
+    def _decode_matrix(self, use: Tuple[int, ...]) -> np.ndarray:
+        """Inverse of the encode-matrix rows for the surviving shard set."""
+        if use not in self._decode_cache:
+            sub = self.matrix[list(use)]  # (data, data)
+            self._decode_cache[use] = gf256.gf_inv_matrix_np(sub)
+        return self._decode_cache[use]
+
+    # ---------------------------------------------------------------- device
+    def encode_jax(self, data):
+        """Batched device encode.
+
+        data: uint8 (..., data_shards, B) → (..., total_shards, B).
+        Lowered to one int8 MXU matmul via the bit-plane trick.
+        """
+        import jax.numpy as jnp
+
+        if self.parity_shards == 0:
+            return data
+        # (..., k, B) → (..., B, k) for the symbol-contraction layout.
+        d = jnp.swapaxes(data, -1, -2)
+        parity = gf256.gf_apply_bitmatrix(d, jnp.asarray(self._parity_bits))
+        parity = jnp.swapaxes(parity, -1, -2)  # (..., parity, B)
+        return jnp.concatenate([data, parity], axis=-2)
+
+    def decode_bits(self, use: Tuple[int, ...]) -> np.ndarray:
+        """Constant bit-matrix reconstructing data shards from rows ``use``."""
+        return gf256.gf_matrix_to_bits(self._decode_matrix(tuple(use)))
+
+    def reconstruct_jax(self, survivors, use: Tuple[int, ...]):
+        """Batched device reconstruct for one survivor pattern.
+
+        survivors: uint8 (..., data_shards, B) — the shards at indices
+        ``use`` (in that order).  Returns (..., data_shards, B) data shards.
+        """
+        import jax.numpy as jnp
+
+        s = jnp.swapaxes(survivors, -1, -2)
+        data = gf256.gf_apply_bitmatrix(s, jnp.asarray(self.decode_bits(use)))
+        return jnp.swapaxes(data, -1, -2)
+
+
+@functools.lru_cache(maxsize=256)
+def for_n_f(n: int, f: int) -> ReedSolomon:
+    """The RBC coder for an (n, f) network: data = n−2f, parity = 2f."""
+    return ReedSolomon(n - 2 * f, 2 * f)

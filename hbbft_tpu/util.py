@@ -1,0 +1,25 @@
+"""Small shared helpers (reference: ``src/util.rs``)."""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+
+class SubRng:
+    """Fork child RNGs from a parent deterministically.
+
+    Reference: ``src/util.rs :: SubRng`` — protocols that need randomness
+    (e.g. ``TransactionQueue::choose``) get a forked RNG so runs stay
+    reproducible from one seed.
+    """
+
+    @staticmethod
+    def sub_rng(parent: random.Random) -> random.Random:
+        return random.Random(parent.getrandbits(64))
+
+
+def fmt_hex(data: bytes, max_len: int = 8) -> str:
+    """Short hex rendering for logs (reference: ``hex_fmt`` crate usage)."""
+    h = data[:max_len].hex()
+    return h + ("…" if len(data) > max_len else "")

@@ -1,0 +1,35 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (no TPU required, deterministic,
+fast): the env vars below must be set before jax initializes its backends, so
+this module sets them at import time — pytest imports conftest before any
+test module imports jax.
+"""
+
+import os
+
+# Force CPU with 8 virtual devices even when the session env points JAX at a
+# TPU tunnel (JAX_PLATFORMS=axon, registered by a sitecustomize that imports
+# jax before any test code runs — so plain env vars are too late and we must
+# go through jax.config).  Unit tests must be fast, local, and deterministic;
+# the TPU is for bench.py.
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import random
+
+import pytest
+
+
+@pytest.fixture
+def rng():
+    """Seeded RNG — every test failure reproduces from this seed."""
+    return random.Random(0x48425446)  # "HBTF"

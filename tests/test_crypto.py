@@ -1,0 +1,194 @@
+"""BLS12-381 + threshold-crypto tests.
+
+Covers the curve layer (parameters, bilinearity, hash-to-curve), plain keys,
+threshold signatures (share/combine round-trip — the reference's
+``tests/threshold_sign.rs`` analog), TPKE, and the DKG polynomial substrate.
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu.crypto import bls12_381 as c
+from hbbft_tpu.crypto import tc
+
+
+def test_parameters_derived_from_x():
+    # p and r follow from the BLS12 family formulas — transcription guard.
+    assert c.P % 2 == 1 and c.R % 2 == 1
+    assert (c.P**4 - c.P**2 + 1) % c.R == 0
+    assert ((c.X - 1) ** 2 * c.R // 3 + c.X) == c.P
+
+
+def test_generators():
+    assert c.g1_is_on_curve(c.G1_GEN)
+    assert c.g2_is_on_curve(c.G2_GEN)
+    assert c._g1_mul_nat(c.G1_GEN, c.R) is None
+    assert c.g2_mul(c.G2_GEN, c.R, mod_r=False) is None
+
+
+def test_group_ops():
+    p2 = c.g1_add(c.G1_GEN, c.G1_GEN)
+    assert c.g1_eq(p2, c.g1_double(c.G1_GEN))
+    assert c.g1_eq(c.g1_mul(c.G1_GEN, 5), c.g1_add(p2, c.g1_add(p2, c.G1_GEN)))
+    assert c.g1_add(c.G1_GEN, c.g1_neg(c.G1_GEN)) is None
+    q2 = c.g2_add(c.G2_GEN, c.G2_GEN)
+    assert c.g2_eq(q2, c.g2_double(c.G2_GEN))
+    assert c.g2_add(c.G2_GEN, c.g2_neg(c.G2_GEN)) is None
+
+
+def test_pairing_bilinear():
+    e = c.pairing(c.G1_GEN, c.G2_GEN)
+    assert e != c.FP12_ONE
+    lhs = c.pairing(c.g1_mul(c.G1_GEN, 6), c.g2_mul(c.G2_GEN, 5))
+    assert lhs == c.fp12_pow(e, 30)
+    # product check
+    assert c.pairing_check(
+        [(c.g1_neg(c.g1_mul(c.G1_GEN, 30)), c.G2_GEN),
+         (c.g1_mul(c.G1_GEN, 6), c.g2_mul(c.G2_GEN, 5))]
+    )
+
+
+def test_hash_g2_subgroup_and_determinism():
+    h1 = c.hash_g2(b"doc")
+    h2 = c.hash_g2(b"doc")
+    assert c.g2_eq(h1, h2)
+    assert not c.g2_eq(h1, c.hash_g2(b"doc2"))
+    assert c.g2_mul(h1, c.R, mod_r=False) is None
+
+
+def test_point_serialization_roundtrip():
+    pt = c.g1_mul(c.G1_GEN, 12345)
+    assert c.g1_eq(c.g1_from_bytes(c.g1_to_bytes(pt)), pt)
+    qt = c.g2_mul(c.G2_GEN, 54321)
+    assert c.g2_eq(c.g2_from_bytes(c.g2_to_bytes(qt)), qt)
+    assert c.g1_from_bytes(c.g1_to_bytes(None)) is None
+    with pytest.raises(ValueError):
+        c.g1_from_bytes(b"\x00" + bytes(96))
+
+
+def test_plain_sign_verify(rng):
+    sk = tc.SecretKey.random(rng)
+    pk = sk.public_key()
+    sig = sk.sign(b"hello")
+    assert pk.verify(sig, b"hello")
+    assert not pk.verify(sig, b"other")
+    sk2 = tc.SecretKey.random(rng)
+    assert not sk2.public_key().verify(sig, b"hello")
+
+
+def test_plain_encrypt_decrypt(rng):
+    sk = tc.SecretKey.random(rng)
+    pk = sk.public_key()
+    msg = b"attack at dawn" * 5
+    ct = pk.encrypt(msg, rng)
+    assert ct.verify()
+    assert sk.decrypt(ct) == msg
+    # tampered ciphertext fails CCA check
+    bad = tc.Ciphertext(ct.u, ct.v[:-1] + bytes([ct.v[-1] ^ 1]), ct.w)
+    assert not bad.verify()
+    assert sk.decrypt(bad) is None
+
+
+@pytest.mark.parametrize("t,n", [(1, 4), (2, 7)])
+def test_threshold_signature_roundtrip(t, n, rng):
+    sks = tc.SecretKeySet.random(t, rng)
+    pks = sks.public_keys()
+    msg = b"common coin doc"
+    shares = {i: sks.secret_key_share(i).sign(msg) for i in range(n)}
+    # each share verifies under its public key share
+    for i in range(n):
+        assert pks.verify_signature_share(i, shares[i], msg)
+        assert not pks.verify_signature_share((i + 1) % n, shares[i], msg)
+    # any t+1 subset combines to the same valid master signature
+    sig_a = pks.combine_signatures({i: shares[i] for i in range(t + 1)})
+    sig_b = pks.combine_signatures({i: shares[i] for i in range(n - t - 1, n)})
+    assert sig_a == sig_b
+    assert pks.verify_signature(sig_a, msg)
+    # and equals the master-key signature (interpolation correctness)
+    master = tc.SecretKey(sks.poly.evaluate(0))
+    assert master.sign(msg) == sig_a
+
+
+def test_threshold_signature_too_few_shares(rng):
+    sks = tc.SecretKeySet.random(2, rng)
+    pks = sks.public_keys()
+    shares = {i: sks.secret_key_share(i).sign(b"m") for i in range(2)}
+    with pytest.raises(ValueError):
+        pks.combine_signatures(shares)
+
+
+def test_tpke_roundtrip(rng):
+    t, n = 1, 4
+    sks = tc.SecretKeySet.random(t, rng)
+    pks = sks.public_keys()
+    msg = b"contribution bytes: " + bytes(range(100))
+    ct = pks.public_key().encrypt(msg, rng)
+    assert ct.verify()
+    dshares = {}
+    for i in range(n):
+        sh = sks.secret_key_share(i).decrypt_share(ct)
+        assert sh is not None
+        assert pks.public_key_share(i).verify_decryption_share(sh, ct)
+        dshares[i] = sh
+    # bad share is detected
+    bad = tc.DecryptionShare(c.g1_mul(c.G1_GEN, 99))
+    assert not pks.public_key_share(0).verify_decryption_share(bad, ct)
+    # any t+1 shares decrypt
+    assert pks.decrypt({0: dshares[0], 3: dshares[3]}, ct) == msg
+    assert pks.decrypt(dshares, ct) == msg
+
+
+def test_ciphertext_serialization(rng):
+    sks = tc.SecretKeySet.random(1, rng)
+    ct = sks.public_keys().public_key().encrypt(b"payload", rng)
+    ct2 = tc.Ciphertext.from_bytes(ct.to_bytes())
+    assert ct == ct2 and ct2.verify()
+
+
+def test_poly_interpolate(rng):
+    poly = tc.Poly.random(3, rng)
+    pts = [(x, poly.evaluate(x)) for x in (1, 5, 7, 11)]
+    rec = tc.Poly.interpolate(pts)
+    assert rec.coeffs == poly.coeffs
+
+
+def test_commitment_evaluate(rng):
+    poly = tc.Poly.random(2, rng)
+    com = poly.commitment()
+    for x in (0, 1, 9):
+        assert c.g1_eq(com.evaluate(x), c.g1_mul(c.G1_GEN, poly.evaluate(x)))
+
+
+def test_bivar_poly_symmetry_and_rows(rng):
+    t = 2
+    bp = tc.BivarPoly.random(t, rng)
+    assert bp.evaluate(3, 8) == bp.evaluate(8, 3)
+    row2 = bp.row(2)
+    assert row2.evaluate(5) == bp.evaluate(2, 5)
+    com = bp.commitment()
+    # commitment row matches row's own commitment
+    assert com.row(2) == row2.commitment()
+    assert c.g1_eq(com.evaluate(2, 5), c.g1_mul(c.G1_GEN, bp.evaluate(2, 5)))
+
+
+def test_dkg_style_aggregation(rng):
+    """Sum of dealer bivariate polys behaves like one threshold key set."""
+    t, n = 1, 4
+    dealers = [tc.BivarPoly.random(t, rng) for _ in range(3)]
+    # node i's secret share = Σ_d f_d(i+1, 0)
+    shares = [
+        tc.SecretKeyShare(
+            sum(d.evaluate(i + 1, 0) for d in dealers) % tc.R
+        )
+        for i in range(n)
+    ]
+    # public commitment = Σ_d commit_d.row(0)
+    com = dealers[0].commitment().row(0)
+    for d in dealers[1:]:
+        com = com + d.commitment().row(0)
+    pks = tc.PublicKeySet(com)
+    msg = b"post-dkg doc"
+    sig_shares = {i: shares[i].sign(msg) for i in range(t + 1)}
+    sig = pks.combine_signatures(sig_shares)
+    assert pks.verify_signature(sig, msg)

@@ -1,0 +1,107 @@
+"""GF(2^8) field tests: axioms, table consistency, bit-plane lowering."""
+
+import numpy as np
+import pytest
+
+from hbbft_tpu.ops import gf256
+
+
+def test_exp_log_roundtrip():
+    for a in range(1, 256):
+        assert gf256.GF_EXP[gf256.GF_LOG[a]] == a
+
+
+def test_mul_axioms():
+    rng = np.random.RandomState(1)
+    a = rng.randint(0, 256, 200).astype(np.uint8)
+    b = rng.randint(0, 256, 200).astype(np.uint8)
+    c = rng.randint(0, 256, 200).astype(np.uint8)
+    # commutative, distributive over XOR
+    assert np.array_equal(gf256.gf_mul(a, b), gf256.gf_mul(b, a))
+    assert np.array_equal(
+        gf256.gf_mul(a, b ^ c), gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+    )
+    # identity and zero
+    assert np.array_equal(gf256.gf_mul(a, np.uint8(1)), a)
+    assert np.all(gf256.gf_mul(a, np.uint8(0)) == 0)
+
+
+def test_mul_matches_carryless_reference():
+    def slow_mul(a, b):
+        r = 0
+        while b:
+            if b & 1:
+                r ^= a
+            b >>= 1
+            a <<= 1
+            if a & 0x100:
+                a ^= gf256.GF_POLY
+        return r
+
+    rng = np.random.RandomState(2)
+    for _ in range(300):
+        a, b = int(rng.randint(256)), int(rng.randint(256))
+        assert int(gf256.gf_mul(a, b)) == slow_mul(a, b)
+
+
+def test_inverse():
+    a = np.arange(1, 256, dtype=np.uint8)
+    assert np.all(gf256.gf_mul(a, gf256.gf_inv(a)) == 1)
+    with pytest.raises(ZeroDivisionError):
+        gf256.gf_inv(0)
+
+
+def test_matrix_inverse():
+    rng = np.random.RandomState(3)
+    for n in (1, 2, 5, 16):
+        while True:
+            M = rng.randint(0, 256, (n, n)).astype(np.uint8)
+            try:
+                Minv = gf256.gf_inv_matrix_np(M)
+                break
+            except np.linalg.LinAlgError:
+                continue
+        assert np.array_equal(
+            gf256.gf_matmul_np(M, Minv), np.eye(n, dtype=np.uint8)
+        )
+
+
+def test_bitplane_matches_table_matmul():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(4)
+    r, k, B = 6, 4, 33
+    M = rng.randint(0, 256, (r, k)).astype(np.uint8)
+    D = rng.randint(0, 256, (k, B)).astype(np.uint8)
+    expected = gf256.gf_matmul_np(M, D)  # (r, B)
+
+    bitmat = gf256.gf_matrix_to_bits(M)
+    out = gf256.gf_apply_bitmatrix(jnp.asarray(D.T), jnp.asarray(bitmat))  # (B, r)
+    assert np.array_equal(np.asarray(out).T, expected)
+
+
+def test_bitplane_batched():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(5)
+    r, k, B = 3, 5, 16
+    M = rng.randint(0, 256, (r, k)).astype(np.uint8)
+    D = rng.randint(0, 256, (7, 2, B, k)).astype(np.uint8)
+    bitmat = jnp.asarray(gf256.gf_matrix_to_bits(M))
+    out = jax.jit(lambda d: gf256.gf_apply_bitmatrix(d, bitmat))(jnp.asarray(D))
+    assert out.shape == (7, 2, B, r)
+    for i in range(7):
+        for j in range(2):
+            expected = gf256.gf_matmul_np(M, D[i, j].T).T
+            assert np.array_equal(np.asarray(out[i, j]), expected)
+
+
+def test_gf_mul_jnp_matches_host():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(6)
+    a = rng.randint(0, 256, 500).astype(np.uint8)
+    b = rng.randint(0, 256, 500).astype(np.uint8)
+    out = gf256.gf_mul_jnp(jnp.asarray(a), jnp.asarray(b))
+    assert np.array_equal(np.asarray(out), gf256.gf_mul(a, b))

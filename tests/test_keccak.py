@@ -1,0 +1,40 @@
+"""SHA3-256 jnp implementation vs the hashlib host oracle."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from hbbft_tpu.ops import keccak
+
+
+@pytest.mark.parametrize("length", [0, 1, 31, 32, 135, 136, 137, 271, 272, 500])
+def test_sha3_matches_hashlib(length):
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(length)
+    data = rng.randint(0, 256, (3, length)).astype(np.uint8)
+    out = np.asarray(keccak.sha3_256(jnp.asarray(data)))
+    for i in range(3):
+        expected = hashlib.sha3_256(data[i].tobytes()).digest()
+        assert out[i].tobytes() == expected
+
+
+def test_sha3_batched_multi_axis():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(42)
+    data = rng.randint(0, 256, (2, 3, 40)).astype(np.uint8)
+    out = np.asarray(jax.jit(keccak.sha3_256)(jnp.asarray(data)))
+    assert out.shape == (2, 3, 32)
+    for i in range(2):
+        for j in range(3):
+            assert out[i, j].tobytes() == hashlib.sha3_256(data[i, j].tobytes()).digest()
+
+
+def test_round_constants_known_values():
+    # First and last round constants of keccak-f[1600] (FIPS-202 appendix).
+    assert keccak.ROUND_CONSTANTS[0] == 0x0000000000000001
+    assert keccak.ROUND_CONSTANTS[1] == 0x0000000000008082
+    assert keccak.ROUND_CONSTANTS[23] == 0x8000000080008008

@@ -1,0 +1,85 @@
+"""C++ oracle vs Python/numpy host implementations (bit-exactness)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from hbbft_tpu.ops import gf256, rs
+
+oracle = pytest.importorskip("hbbft_tpu.native").get_oracle()
+
+
+def test_gf_mul_matches():
+    rng = np.random.RandomState(0)
+    a = rng.randint(0, 256, 1000).astype(np.uint8)
+    b = rng.randint(0, 256, 1000).astype(np.uint8)
+    assert np.array_equal(oracle.gf_mul(a, b), gf256.gf_mul(a, b))
+
+
+def test_gf_matmul_matches():
+    rng = np.random.RandomState(1)
+    A = rng.randint(0, 256, (7, 5)).astype(np.uint8)
+    B = rng.randint(0, 256, (5, 11)).astype(np.uint8)
+    assert np.array_equal(oracle.gf_matmul(A, B), gf256.gf_matmul_np(A, B))
+
+
+def test_gf_invert_matches():
+    rng = np.random.RandomState(2)
+    M = rng.randint(0, 256, (6, 6)).astype(np.uint8)
+    try:
+        expected = gf256.gf_inv_matrix_np(M)
+    except np.linalg.LinAlgError:
+        pytest.skip("singular sample")
+    assert np.array_equal(oracle.gf_invert(M), expected)
+
+
+def test_rs_matrix_matches():
+    coder = rs.ReedSolomon(4, 3)
+    assert np.array_equal(oracle.rs_matrix(4, 7), coder.matrix)
+
+
+def test_rs_encode_matches():
+    rng = np.random.RandomState(3)
+    coder = rs.ReedSolomon(5, 4)
+    data = rng.randint(0, 256, (5, 13)).astype(np.uint8)
+    assert np.array_equal(oracle.rs_encode(data, 9), coder.encode_np(data))
+
+
+def test_rs_reconstruct_matches():
+    rng = np.random.RandomState(4)
+    coder = rs.ReedSolomon(4, 4)
+    data = rng.randint(0, 256, (4, 9)).astype(np.uint8)
+    full = [bytes(s) for s in coder.encode_np(data)]
+    holed = [None, full[1], None, full[3], full[4], None, full[6], None]
+    assert oracle.rs_reconstruct(4, holed) == coder.reconstruct_np(holed)
+
+
+def test_sha3_matches_hashlib():
+    for msg in [b"", b"abc", b"x" * 135, b"y" * 136, b"z" * 1000]:
+        assert oracle.sha3_256(msg) == hashlib.sha3_256(msg).digest()
+
+
+def test_sha3_batch():
+    rng = np.random.RandomState(5)
+    msgs = rng.randint(0, 256, (6, 50)).astype(np.uint8)
+    out = oracle.sha3_256_batch(msgs)
+    for i in range(6):
+        assert out[i].tobytes() == hashlib.sha3_256(msgs[i].tobytes()).digest()
+
+
+def test_keccak_permutation_vs_jnp():
+    import jax.numpy as jnp
+
+    from hbbft_tpu.ops import keccak
+
+    rng = np.random.RandomState(6)
+    state = rng.randint(0, 2**63, 25).astype(np.uint64)
+    expected = oracle.keccak_f1600(state)
+    hi = jnp.asarray((state >> np.uint64(32)).astype(np.uint32))
+    lo = jnp.asarray((state & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    ohi, olo = keccak.keccak_f1600(hi, lo)
+    got = (np.asarray(ohi).astype(np.uint64) << np.uint64(32)) | np.asarray(
+        olo
+    ).astype(np.uint64)
+    assert np.array_equal(got, expected)
