@@ -201,8 +201,12 @@ class SecretKeyShare(SecretKey):
     def sign(self, msg: bytes) -> SignatureShare:  # type: ignore[override]
         return SignatureShare(c.g2_mul(c.hash_g2(msg), self.scalar))
 
-    def decrypt_share(self, ct: "Ciphertext") -> Optional["DecryptionShare"]:
-        if not ct.verify():
+    def decrypt_share(
+        self, ct: "Ciphertext", check: bool = True
+    ) -> Optional["DecryptionShare"]:
+        """Our share U^{x_i}.  ``check=False`` skips the (pairing-priced)
+        CCA validity check when the caller already verified the ciphertext."""
+        if check and not ct.verify():
             return None
         return DecryptionShare(c.g1_mul(ct.u, self.scalar))
 

@@ -1,0 +1,16 @@
+"""The sans-I/O consensus state machines (object-mode execution path).
+
+Bottom-up: ``broadcast`` (Bracha RBC) and ``binary_agreement`` (ABA with a
+threshold-signature common coin) feed ``subset`` (ACS), which powers
+``honey_badger`` epochs; ``dynamic_honey_badger`` adds membership changes via
+``sync_key_gen`` (DKG), and ``queueing_honey_badger`` adds the transaction
+queue.  ``sender_queue`` wraps the top-level algorithms to buffer messages
+for lagging peers.
+
+Every protocol implements :class:`hbbft_tpu.traits.ConsensusProtocol` — the
+same contract the batched array-mode simulator in ``hbbft_tpu.parallel``
+re-expresses as dense tensors.  Reference layout: ``src/`` of poanetwork/hbbft
+(see SURVEY.md §1-§3).
+"""
+
+from hbbft_tpu.protocols.broadcast import Broadcast

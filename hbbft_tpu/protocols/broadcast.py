@@ -1,0 +1,261 @@
+"""Bracha reliable broadcast with erasure-coded payload.
+
+Reference: ``src/broadcast/broadcast.rs :: Broadcast`` — the proposer
+RS-encodes the value into N shards (data = N−2f, parity = 2f), commits to
+them with a Merkle tree, and sends each node its shard + proof as ``Value``;
+nodes re-distribute their shard to everyone as ``Echo``; ``Ready(root)`` is
+sent after N−f Echos (or f+1 Readys — Bracha amplification); the value is
+decoded once a node holds 2f+1 Readys and ≥ N−2f Echos, re-encoded, and the
+recomputed Merkle root checked against the agreed one.
+
+Guarantees (with ≤ f Byzantine nodes): if any correct node outputs a value,
+all correct nodes output that same value; if the proposer is correct, that
+value is the proposer's input.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Hashable, Optional, Set, Tuple
+
+import numpy as np
+
+from hbbft_tpu.fault_log import FaultKind
+from hbbft_tpu.netinfo import NetworkInfo
+from hbbft_tpu.ops import rs
+from hbbft_tpu.ops.merkle import MerkleTree, Proof
+from hbbft_tpu.traits import ConsensusProtocol, Step, Target
+
+NodeId = Hashable
+
+
+# -- messages (reference: src/broadcast/message.rs :: Message) --------------
+
+
+@dataclass(frozen=True)
+class ValueMsg:
+    proof: Proof
+
+
+@dataclass(frozen=True)
+class EchoMsg:
+    proof: Proof
+
+
+@dataclass(frozen=True)
+class ReadyMsg:
+    root: bytes
+
+
+BroadcastMessage = object  # ValueMsg | EchoMsg | ReadyMsg
+
+
+class Broadcast(ConsensusProtocol):
+    """Reference: ``src/broadcast/broadcast.rs :: Broadcast<N>``."""
+
+    def __init__(self, netinfo: NetworkInfo, proposer_id: NodeId):
+        if not netinfo.is_node_validator(proposer_id):
+            raise ValueError("proposer is not a validator")
+        self.netinfo = netinfo
+        self.proposer_id = proposer_id
+        n = netinfo.num_nodes()
+        f = netinfo.num_faulty()
+        self.coder = rs.for_n_f(n, f)
+        self.data_shard_num = self.coder.data_shards
+        # state (reference field names)
+        self.echo_sent = False
+        self.ready_sent = False
+        self.decided = False
+        self.value_received = False
+        self.value_proof: Optional[Proof] = None
+        self.echos: Dict[NodeId, Proof] = {}
+        self.readys: Dict[NodeId, bytes] = {}
+        self.output: Optional[bytes] = None
+        self.fault: bool = False  # proposer proven faulty (root mismatch)
+
+    # -- ConsensusProtocol --------------------------------------------------
+
+    def our_id(self) -> NodeId:
+        return self.netinfo.our_id()
+
+    def terminated(self) -> bool:
+        return self.decided or self.fault
+
+    def handle_input(self, input: bytes) -> Step:
+        """Proposer entry point (reference ``Broadcast::broadcast``)."""
+        if self.our_id() != self.proposer_id:
+            raise ValueError("only the proposer can input a value")
+        if self.value_received:
+            return Step()
+        return self._send_shards(bytes(input))
+
+    def handle_message(self, sender_id: NodeId, message) -> Step:
+        if not self.netinfo.is_node_validator(sender_id):
+            return Step.from_fault(sender_id, FaultKind.UnknownSender)
+        if isinstance(message, ValueMsg):
+            return self._handle_value(sender_id, message.proof)
+        if isinstance(message, EchoMsg):
+            return self._handle_echo(sender_id, message.proof)
+        if isinstance(message, ReadyMsg):
+            return self._handle_ready(sender_id, message.root)
+        raise TypeError(f"unknown broadcast message {message!r}")
+
+    # -- internals ----------------------------------------------------------
+
+    def _send_shards(self, value: bytes) -> Step:
+        """RS-encode + Merkle-commit + send per-node ``Value`` proofs.
+
+        Reference: ``Broadcast::send_shards`` (HOT: GF(2^8) matmul + keccak;
+        the batched simulator replaces this whole path with
+        ``parallel.batched_rbc``).
+        """
+        self.value_received = True
+        data = _frame_value(value, self.data_shard_num)
+        shards = self.coder.encode_np(data)
+        tree = MerkleTree.from_vec([s.tobytes() for s in shards])
+        step = Step()
+        my_proof = None
+        ids = self.netinfo.all_ids()
+        for i, nid in enumerate(ids):
+            proof = tree.proof(i)
+            if nid == self.our_id():
+                my_proof = proof
+            else:
+                step.send_to(nid, ValueMsg(proof))
+        if my_proof is not None:
+            step.extend(self._handle_value(self.our_id(), my_proof))
+        return step
+
+    def _validate_proof(self, proof: Proof, sender_id: NodeId) -> bool:
+        """Proof must verify and carry the index of ``sender_id``.
+
+        Reference: ``Broadcast::validate_proof``.
+        """
+        idx = self.netinfo.node_index(sender_id)
+        return (
+            proof.index == idx
+            and proof.validate(self.netinfo.num_nodes())
+        )
+
+    def _handle_value(self, sender_id: NodeId, proof: Proof) -> Step:
+        if sender_id != self.proposer_id:
+            return Step.from_fault(sender_id, FaultKind.NotAProposer)
+        if self.value_received and sender_id != self.our_id():
+            if proof == self.value_proof:
+                return Step()  # network replay — idempotent
+            return Step.from_fault(sender_id, FaultKind.MultipleValues)
+        self.value_received = True
+        self.value_proof = proof
+        # a Value for us carries OUR shard index
+        if proof.index != self.netinfo.node_index(self.our_id()) or not proof.validate(
+            self.netinfo.num_nodes()
+        ):
+            return Step.from_fault(sender_id, FaultKind.InvalidProof)
+        step = Step()
+        if not self.echo_sent:
+            self.echo_sent = True
+            step.send_all(EchoMsg(proof))
+            step.extend(self._handle_echo(self.our_id(), proof))
+        return step
+
+    def _handle_echo(self, sender_id: NodeId, proof: Proof) -> Step:
+        if sender_id in self.echos:
+            if self.echos[sender_id] == proof:
+                return Step()
+            return Step.from_fault(sender_id, FaultKind.MultipleEchos)
+        if not self._validate_proof(proof, sender_id):
+            return Step.from_fault(sender_id, FaultKind.InvalidProof)
+        self.echos[sender_id] = proof
+        step = Step()
+        root = proof.root_hash
+        n, f = self.netinfo.num_nodes(), self.netinfo.num_faulty()
+        if self._count_echos(root) >= n - f and not self.ready_sent:
+            self.ready_sent = True
+            step.send_all(ReadyMsg(root))
+            step.extend(self._handle_ready(self.our_id(), root))
+        step.extend(self._try_decode())
+        return step
+
+    def _handle_ready(self, sender_id: NodeId, root: bytes) -> Step:
+        if sender_id in self.readys:
+            if self.readys[sender_id] == root:
+                return Step()
+            return Step.from_fault(sender_id, FaultKind.MultipleReadys)
+        self.readys[sender_id] = root
+        step = Step()
+        f = self.netinfo.num_faulty()
+        if self._count_readys(root) > f and not self.ready_sent:
+            # Bracha amplification
+            self.ready_sent = True
+            step.send_all(ReadyMsg(root))
+            step.extend(self._handle_ready(self.our_id(), root))
+        step.extend(self._try_decode())
+        return step
+
+    def _count_echos(self, root: bytes) -> int:
+        return sum(1 for p in self.echos.values() if p.root_hash == root)
+
+    def _count_readys(self, root: bytes) -> int:
+        return sum(1 for r in self.readys.values() if r == root)
+
+    def _try_decode(self) -> Step:
+        """Reference: ``Broadcast::compute_output`` — decode when 2f+1
+        Readys agree on a root and ≥ N−2f matching Echos are in hand."""
+        if self.decided or self.fault:
+            return Step()
+        n, f = self.netinfo.num_nodes(), self.netinfo.num_faulty()
+        roots = {r for r in self.readys.values()}
+        for root in roots:
+            if self._count_readys(root) < 2 * f + 1:
+                continue
+            if self._count_echos(root) < self.data_shard_num:
+                continue
+            # reconstruct from the echo shards
+            shards: list = [None] * n
+            for nid, proof in self.echos.items():
+                if proof.root_hash == root:
+                    shards[proof.index] = proof.value
+            try:
+                full = self.coder.reconstruct_np(shards)
+            except ValueError:
+                continue
+            # re-encode & verify the root (defends against a faulty proposer
+            # whose shards don't form a consistent codeword)
+            tree = MerkleTree.from_vec(full)
+            if tree.root_hash() != root:
+                self.fault = True
+                return Step.from_fault(
+                    self.proposer_id, FaultKind.InvalidProof
+                )
+            value = _unframe_value(
+                b"".join(full[: self.data_shard_num])
+            )
+            if value is None:
+                self.fault = True
+                return Step.from_fault(
+                    self.proposer_id, FaultKind.InvalidProof
+                )
+            self.decided = True
+            self.output = value
+            return Step.from_output(value)
+        return Step()
+
+
+# -- framing ----------------------------------------------------------------
+
+
+def _frame_value(value: bytes, data_shards: int) -> np.ndarray:
+    """value → (data_shards, B) uint8: 4-byte length prefix + value + zeros."""
+    framed = len(value).to_bytes(4, "big") + value
+    shard_len = max(1, -(-len(framed) // data_shards))
+    framed = framed.ljust(data_shards * shard_len, b"\0")
+    return np.frombuffer(framed, dtype=np.uint8).reshape(data_shards, shard_len)
+
+
+def _unframe_value(framed: bytes) -> Optional[bytes]:
+    if len(framed) < 4:
+        return None
+    length = int.from_bytes(framed[:4], "big")
+    if 4 + length > len(framed):
+        return None
+    return framed[4 : 4 + length]

@@ -1,0 +1,361 @@
+"""HoneyBadger atomic broadcast: the epoch loop.
+
+Reference: ``src/honey_badger/`` — ``honey_badger.rs`` (epoch window +
+message routing), ``epoch_state.rs`` (one ``Subset`` + per-proposer
+``ThresholdDecrypt``), ``batch.rs``, ``builder.rs``, ``message.rs``.
+
+Per epoch: each node TPKE-encrypts its serialized contribution under the
+network's threshold public key (per the ``EncryptionSchedule``), proposes the
+ciphertext into that epoch's ``Subset``; when the ACS delivers the agreed
+ciphertext set, every validator publishes a decryption share per accepted
+ciphertext; t+1 shares decrypt each one, and the epoch closes with a
+``Batch`` of (proposer → contribution bytes), identical on every correct
+node and in epoch order.
+
+Contribution payloads here are opaque bytes; ``DynamicHoneyBadger``/
+``QueueingHoneyBadger`` own (de)serialization (the reference uses bincode at
+this boundary and faults ``BatchDeserializationFailed``; our equivalent
+fault is raised there).
+"""
+
+from __future__ import annotations
+
+import random
+import struct
+from dataclasses import dataclass, field
+from typing import Any, Dict, Hashable, List, Optional, Tuple
+
+from hbbft_tpu.crypto import tc
+from hbbft_tpu.fault_log import FaultKind
+from hbbft_tpu.netinfo import NetworkInfo
+from hbbft_tpu.protocols import subset as subset_mod
+from hbbft_tpu.protocols.subset import Subset
+from hbbft_tpu.protocols.threshold_decrypt import (
+    DecryptionMessage,
+    ThresholdDecrypt,
+)
+from hbbft_tpu.traits import ConsensusProtocol, Step
+
+NodeId = Hashable
+
+
+# -- encryption schedule (reference: EncryptionSchedule) ---------------------
+
+
+class EncryptionSchedule:
+    """When to TPKE-encrypt contributions.
+
+    Reference variants: ``Always``, ``Never``, ``EveryNthEpoch(n)``,
+    ``TickTock(on, off)``.
+    """
+
+    def __init__(self, kind: str, a: int = 0, b: int = 0):
+        self.kind = kind
+        self.a = a
+        self.b = b
+
+    @classmethod
+    def always(cls):
+        return cls("always")
+
+    @classmethod
+    def never(cls):
+        return cls("never")
+
+    @classmethod
+    def every_nth_epoch(cls, n: int):
+        return cls("nth", n)
+
+    @classmethod
+    def tick_tock(cls, on: int, off: int):
+        return cls("ticktock", on, off)
+
+    def encrypt_on_epoch(self, epoch: int) -> bool:
+        if self.kind == "always":
+            return True
+        if self.kind == "never":
+            return False
+        if self.kind == "nth":
+            return epoch % self.a == 0
+        period = self.a + self.b
+        return (epoch % period) < self.a
+
+
+# -- messages ---------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SubsetWrap:
+    epoch: int
+    msg: object
+
+
+@dataclass(frozen=True)
+class DecryptionShareWrap:
+    epoch: int
+    proposer_id: NodeId
+    msg: DecryptionMessage
+
+
+# -- batch ------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Batch:
+    """Reference: ``src/honey_badger/batch.rs :: Batch<C, N>``."""
+
+    epoch: int
+    contributions: Tuple[Tuple[NodeId, bytes], ...]
+
+    def contributions_map(self) -> Dict[NodeId, bytes]:
+        return dict(self.contributions)
+
+    def is_empty(self) -> bool:
+        return not self.contributions
+
+
+# -- epoch state ------------------------------------------------------------
+
+_PLAIN = 0x00
+_ENCRYPTED = 0x01
+
+
+class _EpochState:
+    """Reference: ``src/honey_badger/epoch_state.rs :: EpochState``."""
+
+    def __init__(self, netinfo: NetworkInfo, session_id: bytes, epoch: int):
+        self.netinfo = netinfo
+        self.epoch = epoch
+        self.subset = Subset(
+            netinfo, session_id + b"/hb-epoch/" + struct.pack(">Q", epoch)
+        )
+        self.decrypts: Dict[NodeId, ThresholdDecrypt] = {}
+        self.plain: Dict[NodeId, bytes] = {}
+        self.excluded: set = set()
+        self.subset_done = False
+        self.accepted: set = set()
+
+    def decrypted_all(self) -> bool:
+        return self.subset_done and all(
+            pid in self.plain or pid in self.excluded for pid in self.accepted
+        )
+
+    def batch(self) -> Batch:
+        return Batch(
+            epoch=self.epoch,
+            contributions=tuple(
+                sorted(self.plain.items(), key=lambda kv: repr(kv[0]))
+            ),
+        )
+
+
+class HoneyBadgerBuilder:
+    """Reference: ``src/honey_badger/builder.rs``."""
+
+    def __init__(self, netinfo: NetworkInfo):
+        self.netinfo = netinfo
+        self._session_id = b"hb"
+        self._max_future_epochs = 3
+        self._encryption_schedule = EncryptionSchedule.always()
+        self._rng: Optional[random.Random] = None
+
+    def session_id(self, sid: bytes) -> "HoneyBadgerBuilder":
+        self._session_id = bytes(sid)
+        return self
+
+    def max_future_epochs(self, n: int) -> "HoneyBadgerBuilder":
+        self._max_future_epochs = n
+        return self
+
+    def encryption_schedule(self, es: EncryptionSchedule) -> "HoneyBadgerBuilder":
+        self._encryption_schedule = es
+        return self
+
+    def rng(self, rng: random.Random) -> "HoneyBadgerBuilder":
+        self._rng = rng
+        return self
+
+    def build(self) -> "HoneyBadger":
+        return HoneyBadger(
+            self.netinfo,
+            session_id=self._session_id,
+            max_future_epochs=self._max_future_epochs,
+            encryption_schedule=self._encryption_schedule,
+            rng=self._rng or random.Random(0),
+        )
+
+
+class HoneyBadger(ConsensusProtocol):
+    """Reference: ``src/honey_badger/honey_badger.rs :: HoneyBadger<C, N>``."""
+
+    def __init__(
+        self,
+        netinfo: NetworkInfo,
+        session_id: bytes = b"hb",
+        max_future_epochs: int = 3,
+        encryption_schedule: Optional[EncryptionSchedule] = None,
+        rng: Optional[random.Random] = None,
+    ):
+        self.netinfo = netinfo
+        self.session_id = bytes(session_id)
+        self.epoch = 0
+        self.max_future_epochs = max_future_epochs
+        self.encryption_schedule = encryption_schedule or EncryptionSchedule.always()
+        self.rng = rng or random.Random(0)
+        self.epochs: Dict[int, _EpochState] = {}
+        self.has_input: Dict[int, bool] = {}
+        self.completed: Dict[int, Batch] = {}
+
+    @classmethod
+    def builder(cls, netinfo: NetworkInfo) -> HoneyBadgerBuilder:
+        return HoneyBadgerBuilder(netinfo)
+
+    # -- ConsensusProtocol ---------------------------------------------------
+
+    def our_id(self) -> NodeId:
+        return self.netinfo.our_id()
+
+    def terminated(self) -> bool:
+        return False  # atomic broadcast runs forever
+
+    def next_epoch(self) -> int:
+        return self.epoch
+
+    def handle_input(self, input: bytes) -> Step:
+        return self.propose(input)
+
+    def propose(self, contribution: bytes) -> Step:
+        """Encrypt (per schedule) and propose into the current epoch's ACS.
+
+        Reference: ``HoneyBadger::propose`` (HOT: TPKE encrypt —
+        G1/G2 scalar muls; batched on TPU in ``parallel.batched_hb``).
+        """
+        if self.has_input.get(self.epoch):
+            return Step()
+        self.has_input[self.epoch] = True
+        if self.encryption_schedule.encrypt_on_epoch(self.epoch):
+            ct = (
+                self.netinfo.public_key_set()
+                .public_key()
+                .encrypt(bytes(contribution), self.rng)
+            )
+            payload = bytes([_ENCRYPTED]) + ct.to_bytes()
+        else:
+            payload = bytes([_PLAIN]) + bytes(contribution)
+        state = self._epoch_state(self.epoch)
+        inner = state.subset.handle_input(payload)
+        return self._process_subset_step(self.epoch, inner)
+
+    def handle_message(self, sender_id: NodeId, message) -> Step:
+        if not self.netinfo.is_node_validator(sender_id):
+            return Step.from_fault(sender_id, FaultKind.UnknownSender)
+        epoch = message.epoch
+        if epoch < self.epoch:
+            return Step()  # obsolete epoch
+        if epoch > self.epoch + self.max_future_epochs:
+            return Step.from_fault(sender_id, FaultKind.UnexpectedHbMessage)
+        if isinstance(message, SubsetWrap):
+            state = self._epoch_state(epoch)
+            inner = state.subset.handle_message(sender_id, message.msg)
+            return self._process_subset_step(epoch, inner)
+        if isinstance(message, DecryptionShareWrap):
+            if not self.netinfo.is_node_validator(message.proposer_id):
+                # unknown proposer: reject before creating any state
+                return Step.from_fault(
+                    sender_id, FaultKind.UnexpectedDecryptionShare
+                )
+            state = self._epoch_state(epoch)
+            td = self._decrypt_for(state, message.proposer_id)
+            inner = td.handle_message(sender_id, message.msg)
+            return self._process_decrypt_step(epoch, message.proposer_id, inner)
+        raise TypeError(f"unknown honey_badger message {message!r}")
+
+    # -- internals -----------------------------------------------------------
+
+    def _epoch_state(self, epoch: int) -> _EpochState:
+        if epoch not in self.epochs:
+            self.epochs[epoch] = _EpochState(
+                self.netinfo, self.session_id, epoch
+            )
+        return self.epochs[epoch]
+
+    def _decrypt_for(self, state: _EpochState, proposer_id: NodeId) -> ThresholdDecrypt:
+        if proposer_id not in state.decrypts:
+            state.decrypts[proposer_id] = ThresholdDecrypt(self.netinfo)
+        return state.decrypts[proposer_id]
+
+    def _process_subset_step(self, epoch: int, inner: Step) -> Step:
+        step = inner.map(lambda m: SubsetWrap(epoch, m))
+        state = self.epochs.get(epoch)
+        if state is None:  # epoch already closed mid-step
+            step.output = []
+            return step
+        outputs = step.output
+        step.output = []
+        for out in outputs:
+            if isinstance(out, subset_mod.Contribution):
+                step.extend(
+                    self._on_accepted(epoch, out.proposer_id, out.value)
+                )
+            elif isinstance(out, subset_mod.Done):
+                state.subset_done = True
+        return step.extend(self._try_complete(epoch))
+
+    def _on_accepted(self, epoch: int, proposer_id: NodeId, payload: bytes) -> Step:
+        """An ACS-accepted contribution: plaintext or ciphertext to decrypt."""
+        state = self.epochs[epoch]
+        state.accepted.add(proposer_id)
+        step = Step()
+        if not payload:
+            state.excluded.add(proposer_id)
+            return step.fault(proposer_id, FaultKind.InvalidCiphertext)
+        tag, body = payload[0], payload[1:]
+        if tag == _PLAIN:
+            state.plain[proposer_id] = body
+            return step
+        if tag != _ENCRYPTED:
+            state.excluded.add(proposer_id)
+            return step.fault(proposer_id, FaultKind.InvalidCiphertext)
+        try:
+            ct = tc.Ciphertext.from_bytes(body)
+            ok = ct.verify()
+        except (ValueError, IndexError):
+            ok = False
+        if not ok:
+            # all correct nodes agree (same RBC bytes) → consistent exclusion
+            state.excluded.add(proposer_id)
+            return step.fault(proposer_id, FaultKind.InvalidCiphertext)
+        td = self._decrypt_for(state, proposer_id)
+        inner = td.set_ciphertext(ct)
+        return step.extend(self._process_decrypt_step(epoch, proposer_id, inner))
+
+    def _process_decrypt_step(
+        self, epoch: int, proposer_id: NodeId, inner: Step
+    ) -> Step:
+        step = inner.map(
+            lambda m: DecryptionShareWrap(epoch, proposer_id, m)
+        )
+        state = self.epochs.get(epoch)
+        if state is None:  # epoch already closed mid-step
+            step.output = []
+            return step
+        outputs = step.output
+        step.output = []
+        for plaintext in outputs:
+            state.plain[proposer_id] = plaintext
+        return step.extend(self._try_complete(epoch))
+
+    def _try_complete(self, epoch: int) -> Step:
+        """Close completed epochs in order (reference ``update_epoch``)."""
+        state = self.epochs.get(epoch)
+        if state is None:
+            return Step()
+        if epoch not in self.completed and state.decrypted_all():
+            self.completed[epoch] = state.batch()
+        step = Step()
+        while self.epoch in self.completed:
+            batch = self.completed.pop(self.epoch)
+            step.output.append(batch)
+            del self.epochs[self.epoch]
+            self.epoch += 1
+        return step

@@ -1,0 +1,162 @@
+"""Threshold decryption of one TPKE ciphertext.
+
+Reference: ``src/threshold_decrypt.rs :: ThresholdDecrypt<N>`` — collect
+t+1 = f+1 valid decryption shares for a ciphertext and interpolate the
+plaintext mask.
+
+Optimisation over the reference (which pairing-verifies every share): a
+Fiat–Shamir batch verification — check
+``e(Σ ρ_i·share_i, H) == e(Σ ρ_i·pk_i, W)`` with coefficients ρ_i derived by
+hashing the share set — one pairing-check for the whole set; per-share
+verification only runs as a fallback to attribute blame.  The batched TPU
+verifier uses the identical random-linear-combination trick.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Dict, Hashable, Optional
+
+from hbbft_tpu.crypto import bls12_381 as bls
+from hbbft_tpu.crypto import tc
+from hbbft_tpu.fault_log import FaultKind
+from hbbft_tpu.netinfo import NetworkInfo
+from hbbft_tpu.traits import ConsensusProtocol, Step
+
+NodeId = Hashable
+
+
+@dataclass(frozen=True)
+class DecryptionMessage:
+    share: tc.DecryptionShare
+
+
+class ThresholdDecrypt(ConsensusProtocol):
+    """Reference: ``src/threshold_decrypt.rs``."""
+
+    def __init__(self, netinfo: NetworkInfo):
+        self.netinfo = netinfo
+        self.ciphertext: Optional[tc.Ciphertext] = None
+        self.shares: Dict[NodeId, tc.DecryptionShare] = {}
+        self.verified: Dict[NodeId, bool] = {}
+        self.pending: Dict[NodeId, tc.DecryptionShare] = {}
+        self.plaintext: Optional[bytes] = None
+        self.had_input = False
+
+    def our_id(self) -> NodeId:
+        return self.netinfo.our_id()
+
+    def terminated(self) -> bool:
+        return self.plaintext is not None
+
+    # -- API ----------------------------------------------------------------
+
+    def set_ciphertext(self, ct: tc.Ciphertext) -> Step:
+        """Set the ciphertext, emit our share, process buffered shares.
+
+        The caller must have validated ``ct`` (``Ciphertext.verify``) —
+        HoneyBadger does this when accepting a subset contribution.
+        """
+        if self.ciphertext is not None:
+            return Step()
+        self.ciphertext = ct
+        step = Step()
+        if self.netinfo.is_validator():
+            self.had_input = True
+            # check=False: HoneyBadger validates the ciphertext on acceptance
+            share = self.netinfo.secret_key_share().decrypt_share(
+                ct, check=False
+            )
+            step.send_all(DecryptionMessage(share))
+            step.extend(self._handle_share(self.our_id(), share))
+        pending, self.pending = self.pending, {}
+        for sender, share in pending.items():
+            step.extend(self._handle_share(sender, share))
+        return step
+
+    def handle_input(self, input: tc.Ciphertext) -> Step:
+        return self.set_ciphertext(input)
+
+    def handle_message(self, sender_id: NodeId, message) -> Step:
+        if not self.netinfo.is_node_validator(sender_id):
+            return Step.from_fault(sender_id, FaultKind.UnknownSender)
+        if not isinstance(message, DecryptionMessage):
+            raise TypeError(f"unknown threshold_decrypt message {message!r}")
+        if self.ciphertext is None:
+            if sender_id in self.pending:
+                if self.pending[sender_id] == message.share:
+                    return Step()  # network replay — idempotent
+                return Step.from_fault(
+                    sender_id, FaultKind.MultipleDecryptionShares
+                )
+            self.pending[sender_id] = message.share
+            return Step()
+        return self._handle_share(sender_id, message.share)
+
+    # -- internals ----------------------------------------------------------
+
+    def _handle_share(self, sender_id: NodeId, share: tc.DecryptionShare) -> Step:
+        if self.plaintext is not None:
+            return Step()
+        if sender_id in self.shares:
+            if self.shares[sender_id] == share:
+                return Step()  # network replay — idempotent
+            return Step.from_fault(sender_id, FaultKind.MultipleDecryptionShares)
+        self.shares[sender_id] = share
+        return self._try_output()
+
+    def _batch_verify(self, items) -> bool:
+        """One pairing-check for many shares via a hash-derived random
+        linear combination (soundness error ~2^-255)."""
+        ct = self.ciphertext
+        h = tc._hash_ciphertext_point(ct.u, ct.v)
+        seed = hashlib.sha3_256(
+            b"HBBFT-TD-BATCH"
+            + ct.to_bytes()
+            + b"".join(s.to_bytes() for _, s in items)
+        ).digest()
+        acc_share = None
+        acc_pk = None
+        for k, (idx, share) in enumerate(items):
+            rho = (
+                int.from_bytes(
+                    hashlib.sha3_256(seed + k.to_bytes(4, "big")).digest(), "big"
+                )
+                % bls.R
+            )
+            acc_share = bls.g1_add(acc_share, bls.g1_mul(share.point, rho))
+            pk_i = self.netinfo.public_key_set().public_key_share(idx)
+            acc_pk = bls.g1_add(acc_pk, bls.g1_mul(pk_i.point, rho))
+        return bls.pairing_check(
+            [(bls.g1_neg(acc_share), h), (acc_pk, ct.w)]
+        )
+
+    def _try_output(self) -> Step:
+        pks = self.netinfo.public_key_set()
+        t = pks.threshold()
+        if len(self.shares) < t + 1:
+            return Step()
+        chosen = sorted(self.shares.items(), key=lambda kv: repr(kv[0]))[: t + 1]
+        items = [(self.netinfo.node_index(nid), s) for nid, s in chosen]
+        if self._batch_verify(items):
+            plaintext = pks.decrypt(dict(items), self.ciphertext)
+            self.plaintext = plaintext
+            return Step.from_output(plaintext)
+        # someone lied: verify individually, evict, wait for more
+        step = Step()
+        for nid in [nid for nid, _ in chosen]:
+            if self.verified.get(nid):
+                continue
+            idx = self.netinfo.node_index(nid)
+            ok = pks.public_key_share(idx).verify_decryption_share(
+                self.shares[nid], self.ciphertext
+            )
+            if ok:
+                self.verified[nid] = True
+            else:
+                del self.shares[nid]
+                step.fault(nid, FaultKind.InvalidDecryptionShare)
+        if len(self.shares) >= t + 1:
+            step.extend(self._try_output())
+        return step

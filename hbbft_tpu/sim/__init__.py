@@ -1,0 +1,19 @@
+"""Object-mode deterministic network simulation (reference: ``tests/net/``).
+
+``VirtualNet`` is the message-pump event loop the sans-I/O protocols need:
+a queue of in-flight messages, an :class:`~hbbft_tpu.sim.adversary.Adversary`
+that chooses/tampers delivery, and ``crank()`` delivering exactly one message
+at a time.  Fully deterministic from a seed.  The TPU execution path
+(``hbbft_tpu.parallel``) replaces this loop with one device step per
+communication round; this harness is the semantic ground truth it is
+cross-checked against.
+"""
+
+from hbbft_tpu.sim.adversary import (
+    Adversary,
+    NodeOrderAdversary,
+    NullAdversary,
+    RandomAdversary,
+    ReorderingAdversary,
+)
+from hbbft_tpu.sim.virtual_net import CrankError, NetBuilder, VirtualNet

@@ -1,0 +1,82 @@
+"""Delivery-schedule adversaries (reference: ``tests/net/adversary.rs``).
+
+An adversary controls the order in which queued messages are delivered and
+may tamper with or inject messages.  The BFT protocols must stay correct
+under *any* schedule, so tests run each suite under several of these.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import TYPE_CHECKING, List, Optional
+
+if TYPE_CHECKING:
+    from hbbft_tpu.sim.virtual_net import NetworkMessage, VirtualNet
+
+
+class Adversary:
+    """Base: FIFO delivery, no tampering.
+
+    ``pick_message`` returns the index into the queue to deliver next;
+    ``tamper`` may rewrite a message addressed from/to a faulty node.
+    Reference: ``trait Adversary { pre_crank, tamper }``.
+    """
+
+    def pick_message(self, net: "VirtualNet") -> int:
+        return 0
+
+    def tamper(self, net: "VirtualNet", msg: "NetworkMessage") -> Optional["NetworkMessage"]:
+        """Return a replacement for a message sent BY a faulty node (or None
+        to drop it).  Only called for messages from faulty senders."""
+        return msg
+
+
+class NullAdversary(Adversary):
+    """Honest FIFO scheduler."""
+
+
+class NodeOrderAdversary(Adversary):
+    """Delivers messages grouped by destination node id (lowest first).
+
+    Reference: ``NodeOrderAdversary`` — exposes ordering assumptions.
+    """
+
+    def pick_message(self, net: "VirtualNet") -> int:
+        order = {nid: i for i, nid in enumerate(sorted(net.node_ids(), key=repr))}
+        best, best_key = 0, None
+        for i, m in enumerate(net.queue):
+            k = order.get(m.to, len(order))
+            if best_key is None or k < best_key:
+                best, best_key = i, k
+        return best
+
+
+class ReorderingAdversary(Adversary):
+    """Deterministically swaps pairs of queued messages before delivery."""
+
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+
+    def pick_message(self, net: "VirtualNet") -> int:
+        if len(net.queue) >= 2 and self.rng.random() < 0.5:
+            return 1
+        return 0
+
+
+class RandomAdversary(Adversary):
+    """Random delivery order with occasional duplication of messages.
+
+    Reference: ``RandomAdversary`` — random schedule plus message replays;
+    protocols must be idempotent against duplicates.
+    """
+
+    def __init__(self, seed: int = 0, dup_prob: float = 0.05):
+        self.rng = random.Random(seed)
+        self.dup_prob = dup_prob
+
+    def pick_message(self, net: "VirtualNet") -> int:
+        i = self.rng.randrange(len(net.queue))
+        if self.rng.random() < self.dup_prob:
+            # duplicate: re-enqueue a copy before delivery
+            net.queue.append(net.queue[i])
+        return i

@@ -1,0 +1,181 @@
+"""The deterministic in-process message-pump simulator.
+
+Reference: ``tests/net/mod.rs :: VirtualNet / NetBuilder`` — the event loop
+that owns message delivery for the sans-I/O protocol objects.  ``crank()``
+delivers exactly one message (chosen by the adversary), feeds it to the
+destination node, fans out the resulting ``Step.messages`` (resolving
+``Target::All`` etc. against the membership), and records outputs and faults.
+
+Faulty nodes here are *crash/byzantine-by-adversary*: their outgoing messages
+pass through ``Adversary.tamper`` (which may rewrite or drop them), and they
+can be driven by custom algorithms supplied via ``NetBuilder.faulty``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence
+
+from hbbft_tpu.fault_log import Fault, FaultLog
+from hbbft_tpu.sim.adversary import Adversary, NullAdversary
+from hbbft_tpu.traits import Step, TargetedMessage
+
+NodeId = Hashable
+
+
+class CrankError(Exception):
+    """Limit exceeded (reference: ``tests/net/err.rs :: CrankError``)."""
+
+
+@dataclass
+class NetworkMessage:
+    sender: NodeId
+    to: NodeId
+    payload: Any
+
+
+@dataclass
+class Node:
+    node_id: NodeId
+    algorithm: Any  # a ConsensusProtocol
+    is_faulty: bool = False
+    outputs: List[Any] = field(default_factory=list)
+    faults_observed: FaultLog = field(default_factory=FaultLog)
+
+
+class VirtualNet:
+    def __init__(
+        self,
+        nodes: Dict[NodeId, Node],
+        adversary: Optional[Adversary] = None,
+        message_limit: Optional[int] = None,
+        crank_limit: Optional[int] = None,
+    ):
+        self.nodes = nodes
+        self.queue: List[NetworkMessage] = []
+        self.adversary = adversary or NullAdversary()
+        self.message_limit = message_limit
+        self.crank_limit = crank_limit
+        self.messages_delivered = 0
+        self.cranks = 0
+
+    # -- topology -----------------------------------------------------------
+
+    def node_ids(self) -> List[NodeId]:
+        return sorted(self.nodes.keys(), key=repr)
+
+    def correct_ids(self) -> List[NodeId]:
+        return [n for n in self.node_ids() if not self.nodes[n].is_faulty]
+
+    # -- driving ------------------------------------------------------------
+
+    def send_input(self, node_id: NodeId, input: Any) -> None:
+        """Feed an input to a node and fan out its step."""
+        node = self.nodes[node_id]
+        step = node.algorithm.handle_input(input)
+        self._process_step(node, step)
+
+    def crank(self) -> Optional[NetworkMessage]:
+        """Deliver exactly one message; None if the queue is empty."""
+        if not self.queue:
+            return None
+        self.cranks += 1
+        if self.crank_limit is not None and self.cranks > self.crank_limit:
+            raise CrankError(f"crank limit {self.crank_limit} exceeded")
+        idx = self.adversary.pick_message(self)
+        msg = self.queue.pop(idx)
+        dest = self.nodes.get(msg.to)
+        if dest is None:
+            return msg
+        step = dest.algorithm.handle_message(msg.sender, msg.payload)
+        self._process_step(dest, step)
+        self.messages_delivered += 1
+        if (
+            self.message_limit is not None
+            and self.messages_delivered > self.message_limit
+        ):
+            raise CrankError(f"message limit {self.message_limit} exceeded")
+        return msg
+
+    def crank_until(
+        self, pred: Callable[["VirtualNet"], bool], max_cranks: int = 1_000_000
+    ) -> None:
+        n = 0
+        while not pred(self):
+            if self.crank() is None:
+                raise CrankError("queue drained before predicate held")
+            n += 1
+            if n > max_cranks:
+                raise CrankError(f"predicate not reached in {max_cranks} cranks")
+
+    def run_to_quiescence(self) -> None:
+        while self.queue:
+            self.crank()
+
+    # -- internals ----------------------------------------------------------
+
+    def _process_step(self, node: Node, step: Step) -> None:
+        node.outputs.extend(step.output)
+        node.faults_observed.extend(step.fault_log)
+        all_ids = self.node_ids()
+        for tm in step.messages:
+            for dest in tm.target.resolve(all_ids, node.node_id):
+                msg = NetworkMessage(node.node_id, dest, tm.message)
+                if node.is_faulty:
+                    tampered = self.adversary.tamper(self, msg)
+                    if tampered is None:
+                        continue
+                    msg = tampered
+                self.queue.append(msg)
+
+
+class NetBuilder:
+    """Reference: ``tests/net/mod.rs :: NetBuilder``.
+
+    ``using_step`` receives (node_id, netinfo_like) and returns the
+    algorithm instance for that node.
+    """
+
+    def __init__(self, ids: Sequence[NodeId]):
+        self.ids = list(ids)
+        self._faulty: set = set()
+        self._adversary: Optional[Adversary] = None
+        self._message_limit: Optional[int] = None
+        self._crank_limit: Optional[int] = None
+
+    def faulty(self, ids: Sequence[NodeId]) -> "NetBuilder":
+        self._faulty = set(ids)
+        return self
+
+    def num_faulty(self, f: int) -> "NetBuilder":
+        """Mark the first f ids faulty."""
+        self._faulty = set(sorted(self.ids, key=repr)[:f])
+        return self
+
+    def adversary(self, adv: Adversary) -> "NetBuilder":
+        self._adversary = adv
+        return self
+
+    def message_limit(self, n: int) -> "NetBuilder":
+        self._message_limit = n
+        return self
+
+    def crank_limit(self, n: int) -> "NetBuilder":
+        self._crank_limit = n
+        return self
+
+    def using_step(self, make_algo: Callable[[NodeId], Any]) -> VirtualNet:
+        nodes = {
+            nid: Node(
+                node_id=nid,
+                algorithm=make_algo(nid),
+                is_faulty=nid in self._faulty,
+            )
+            for nid in self.ids
+        }
+        return VirtualNet(
+            nodes,
+            adversary=self._adversary,
+            message_limit=self._message_limit,
+            crank_limit=self._crank_limit,
+        )

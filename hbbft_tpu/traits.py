@@ -208,7 +208,14 @@ class ConsensusProtocol(abc.ABC, Generic[M, O]):
 
     @abc.abstractmethod
     def handle_message(self, sender_id: NodeId, message: M) -> Step:
-        """Process one message received from ``sender_id``."""
+        """Process one message received from ``sender_id``.
+
+        ``message`` must be one of the protocol's message types: the wire
+        codec / simulator owns that guarantee (the reference gets it from
+        serde — untypeable bytes never reach the protocol).  A wrong *type*
+        raises ``TypeError``; Byzantine *content* in a well-typed message
+        never raises — it is recorded in the step's fault log.
+        """
 
     @abc.abstractmethod
     def terminated(self) -> bool:

@@ -1,0 +1,87 @@
+"""Binary agreement tests (reference: ``tests/binary_agreement.rs``).
+
+Agreement: all correct nodes decide the same bit.  Validity: if all correct
+nodes input b, the decision is b.  Termination under every adversary.
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu.netinfo import NetworkInfo
+from hbbft_tpu.protocols.binary_agreement import BinaryAgreement
+from hbbft_tpu.sim import (
+    NetBuilder,
+    NodeOrderAdversary,
+    NullAdversary,
+    RandomAdversary,
+    ReorderingAdversary,
+)
+
+_INFO_CACHE = {}
+
+
+def infos_for(n, seed=7):
+    key = (n, seed)
+    if key not in _INFO_CACHE:
+        _INFO_CACHE[key] = NetworkInfo.generate_map(
+            list(range(n)), random.Random(seed)
+        )
+    return _INFO_CACHE[key]
+
+
+def run_ba(n, inputs, adversary):
+    infos = infos_for(n)
+    net = NetBuilder(list(range(n))).adversary(adversary).using_step(
+        lambda nid: BinaryAgreement(infos[nid], b"ba-test", 0)
+    )
+    for nid, b in inputs.items():
+        net.send_input(nid, b)
+    net.run_to_quiescence()
+    return net
+
+
+@pytest.mark.parametrize("n", [1, 4, 7])
+@pytest.mark.parametrize("value", [True, False])
+def test_validity_unanimous(n, value):
+    net = run_ba(n, {i: value for i in range(n)}, NullAdversary())
+    for nid in net.node_ids():
+        assert net.nodes[nid].outputs == [value], f"node {nid}"
+        assert net.nodes[nid].algorithm.terminated()
+
+
+@pytest.mark.parametrize(
+    "adv",
+    [
+        NullAdversary(),
+        NodeOrderAdversary(),
+        ReorderingAdversary(seed=5),
+        RandomAdversary(seed=6, dup_prob=0.1),
+    ],
+    ids=["null", "node_order", "reordering", "random"],
+)
+def test_agreement_mixed_inputs(adv):
+    n = 4
+    inputs = {0: True, 1: False, 2: True, 3: False}
+    net = run_ba(n, inputs, adv)
+    decisions = {nid: net.nodes[nid].outputs for nid in net.node_ids()}
+    assert all(len(d) == 1 for d in decisions.values()), decisions
+    assert len({d[0] for d in decisions.values()}) == 1, decisions
+
+
+def test_agreement_many_seeds_mixed():
+    n = 4
+    for seed in range(4):
+        rng = random.Random(seed + 100)
+        inputs = {i: bool(rng.getrandbits(1)) for i in range(n)}
+        net = run_ba(n, inputs, RandomAdversary(seed=seed))
+        decisions = [net.nodes[nid].outputs for nid in net.node_ids()]
+        assert all(len(d) == 1 for d in decisions)
+        assert len({d[0] for d in decisions}) == 1
+        # validity direction: decision must be someone's input
+        assert decisions[0][0] in inputs.values()
+
+
+def test_single_node_decides_immediately():
+    net = run_ba(1, {0: True}, NullAdversary())
+    assert net.nodes[0].outputs == [True]

@@ -1,0 +1,150 @@
+"""Reliable broadcast property tests (reference: ``tests/broadcast.rs``).
+
+All correct nodes must output the proposer's value under every adversary
+schedule; a faulty proposer can prevent output but never cause divergence.
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu.netinfo import NetworkInfo
+from hbbft_tpu.protocols.broadcast import Broadcast, ReadyMsg, ValueMsg
+from hbbft_tpu.sim import (
+    NetBuilder,
+    NodeOrderAdversary,
+    NullAdversary,
+    RandomAdversary,
+    ReorderingAdversary,
+)
+from hbbft_tpu.sim.virtual_net import NetworkMessage
+
+# Broadcast needs no keys — build NetworkInfo with dummy key material.
+
+
+def make_netinfos(n):
+    ids = list(range(n))
+    pub_keys = {i: object() for i in ids}
+    return {
+        i: NetworkInfo(our_id=i, public_keys=pub_keys, public_key_set=None)
+        for i in ids
+    }
+
+
+def run_broadcast(n, adversary, value=b"the proposed value", proposer=0):
+    infos = make_netinfos(n)
+    net = (
+        NetBuilder(list(range(n)))
+        .adversary(adversary)
+        .using_step(lambda nid: Broadcast(infos[nid], proposer))
+    )
+    net.send_input(proposer, value)
+    net.run_to_quiescence()
+    return net
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 7, 10])
+@pytest.mark.parametrize(
+    "adv",
+    [
+        NullAdversary(),
+        NodeOrderAdversary(),
+        ReorderingAdversary(seed=3),
+        RandomAdversary(seed=4),
+    ],
+    ids=["null", "node_order", "reordering", "random"],
+)
+def test_all_nodes_output_value(n, adv):
+    value = b"v" * 50
+    net = run_broadcast(n, adv, value)
+    for nid in net.node_ids():
+        assert net.nodes[nid].outputs == [value], f"node {nid}"
+        assert net.nodes[nid].algorithm.terminated()
+
+
+def test_empty_and_large_values():
+    for value in (b"", b"x", bytes(range(256)) * 40):
+        net = run_broadcast(4, NullAdversary(), value)
+        for nid in net.node_ids():
+            assert net.nodes[nid].outputs == [value]
+
+
+def test_nonzero_proposer():
+    net = run_broadcast(4, NullAdversary(), b"hello", proposer=2)
+    for nid in net.node_ids():
+        assert net.nodes[nid].outputs == [b"hello"]
+
+
+def test_silent_proposer_no_output():
+    infos = make_netinfos(4)
+    net = NetBuilder(list(range(4))).using_step(
+        lambda nid: Broadcast(infos[nid], 0)
+    )
+    # nobody inputs anything
+    net.run_to_quiescence()
+    for nid in net.node_ids():
+        assert net.nodes[nid].outputs == []
+
+
+def test_crashed_proposer_after_value_still_delivers():
+    """If the proposer sends all Values then crashes, echo/ready complete."""
+    infos = make_netinfos(4)
+    net = NetBuilder(list(range(4))).using_step(
+        lambda nid: Broadcast(infos[nid], 0)
+    )
+    net.send_input(0, b"survives crash")
+    # drop every subsequent message FROM node 0 (simulated crash)
+    net.queue = [m for m in net.queue if m.sender != 0 or isinstance(m.payload, ValueMsg)]
+
+    class DropFromZero(NullAdversary):
+        def pick_message(self, net_):
+            # drop node-0 messages lazily
+            while net_.queue and net_.queue[0].sender == 0 and not isinstance(
+                net_.queue[0].payload, ValueMsg
+            ):
+                net_.queue.pop(0)
+            return 0
+
+    net.adversary = DropFromZero()
+    net.run_to_quiescence()
+    for nid in (1, 2, 3):
+        assert net.nodes[nid].outputs == [b"survives crash"], f"node {nid}"
+
+
+def test_byzantine_proposer_equivocation_no_divergence():
+    """A proposer sending two different values: correct nodes never disagree.
+
+    (With n=4, f=1 the echo threshold prevents two roots both reaching
+    2f+1 readys.)
+    """
+    infos = make_netinfos(4)
+    net = NetBuilder(list(range(4))).using_step(
+        lambda nid: Broadcast(infos[nid], 0)
+    )
+    # Byzantine proposer: run two separate Broadcast instances for two values
+    # and interleave their Value messages to split the honest nodes.
+    b_a = Broadcast(infos[0], 0)
+    b_b = Broadcast(infos[0], 0)
+    step_a = b_a.handle_input(b"value A")
+    step_b = b_b.handle_input(b"value B")
+    # deliver A's Values to node 1, B's Values to nodes 2,3
+    for tm in step_a.messages:
+        for dest in tm.target.resolve(net.node_ids(), 0):
+            if dest == 1:
+                net.queue.append(NetworkMessage(0, dest, tm.message))
+    for tm in step_b.messages:
+        for dest in tm.target.resolve(net.node_ids(), 0):
+            if dest in (2, 3):
+                net.queue.append(NetworkMessage(0, dest, tm.message))
+    net.run_to_quiescence()
+    outputs = [tuple(net.nodes[nid].outputs) for nid in (1, 2, 3)]
+    decided = [o for o in outputs if o]
+    # no two correct nodes decided different values
+    assert len({o for o in decided}) <= 1, outputs
+
+
+def test_random_adversary_with_duplication_many_seeds():
+    for seed in range(5):
+        net = run_broadcast(7, RandomAdversary(seed=seed, dup_prob=0.2))
+        for nid in net.node_ids():
+            assert net.nodes[nid].outputs == [b"the proposed value"]

@@ -1,0 +1,133 @@
+"""HoneyBadger epoch tests (reference: ``tests/honey_badger.rs``).
+
+The BASELINE config-1 milestone lives here: N=4 f=1, a 256-tx batch, one
+epoch — all correct nodes commit identical batches, with encryption on.
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu.netinfo import NetworkInfo
+from hbbft_tpu.protocols.honey_badger import (
+    Batch,
+    EncryptionSchedule,
+    HoneyBadger,
+)
+from hbbft_tpu.sim import NetBuilder, NullAdversary, RandomAdversary
+
+_INFO_CACHE = {}
+
+
+def infos_for(n, seed=13):
+    key = (n, seed)
+    if key not in _INFO_CACHE:
+        _INFO_CACHE[key] = NetworkInfo.generate_map(
+            list(range(n)), random.Random(seed)
+        )
+    return _INFO_CACHE[key]
+
+
+def build_net(n, adversary, schedule=None):
+    infos = infos_for(n)
+    return NetBuilder(list(range(n))).adversary(adversary).using_step(
+        lambda nid: HoneyBadger.builder(infos[nid])
+        .session_id(b"hb-test")
+        .encryption_schedule(schedule or EncryptionSchedule.always())
+        .rng(random.Random(1000 + nid))
+        .build()
+    )
+
+
+def batches_of(node):
+    return [o for o in node.outputs if isinstance(o, Batch)]
+
+
+@pytest.mark.parametrize(
+    "schedule",
+    [EncryptionSchedule.always(), EncryptionSchedule.never()],
+    ids=["encrypted", "plain"],
+)
+def test_one_epoch_identical_batches(schedule):
+    n = 4
+    net = build_net(n, NullAdversary(), schedule)
+    for nid in net.node_ids():
+        net.send_input(nid, f"contribution from {nid}".encode())
+    net.run_to_quiescence()
+    all_batches = [batches_of(net.nodes[nid]) for nid in net.node_ids()]
+    assert all(len(b) == 1 for b in all_batches)
+    first = all_batches[0][0]
+    assert first.epoch == 0
+    assert all(b[0] == first for b in all_batches)
+    f = (n - 1) // 3
+    assert len(first.contributions) >= n - f
+    for pid, contrib in first.contributions:
+        assert contrib == f"contribution from {pid}".encode()
+
+
+def test_baseline_config1_n4_f1_256tx_batch():
+    """BASELINE.json config #1: N=4 f=1, 256-tx batch, one epoch."""
+    n = 4
+    txs = [f"tx-{i:04d}".encode() for i in range(256)]
+    # each node contributes a quarter of the batch
+    per_node = {nid: b"|".join(txs[nid::n]) for nid in range(n)}
+    net = build_net(n, NullAdversary())
+    for nid in net.node_ids():
+        net.send_input(nid, per_node[nid])
+    net.run_to_quiescence()
+    batches = [batches_of(net.nodes[nid])[0] for nid in net.node_ids()]
+    assert len({b.contributions for b in batches}) == 1
+    committed = set()
+    for pid, contrib in batches[0].contributions:
+        committed.update(contrib.split(b"|"))
+    f = (n - 1) // 3
+    assert len(committed) >= len(txs) * (n - f) // n
+
+
+def test_multiple_epochs_in_order():
+    n = 4
+    net = build_net(n, NullAdversary())
+    for epoch in range(3):
+        for nid in net.node_ids():
+            net.send_input(nid, f"e{epoch}-от-{nid}".encode())
+        net.run_to_quiescence()
+    for nid in net.node_ids():
+        bs = batches_of(net.nodes[nid])
+        assert [b.epoch for b in bs] == [0, 1, 2]
+    ref = batches_of(net.nodes[0])
+    for nid in (1, 2, 3):
+        assert batches_of(net.nodes[nid]) == ref
+
+
+def test_random_adversary_epoch():
+    n = 4
+    net = build_net(n, RandomAdversary(seed=21, dup_prob=0.05))
+    for nid in net.node_ids():
+        net.send_input(nid, bytes([nid]) * 64)
+    net.run_to_quiescence()
+    batches = [batches_of(net.nodes[nid]) for nid in net.node_ids()]
+    assert all(len(b) == 1 for b in batches)
+    assert len({b[0].contributions for b in batches}) == 1
+
+
+def test_silent_node_excluded_but_epoch_completes():
+    n = 4
+    net = build_net(n, NullAdversary())
+    for nid in (0, 1, 2):  # node 3 proposes nothing
+        net.send_input(nid, bytes([nid]))
+    net.run_to_quiescence()
+    batches = [batches_of(net.nodes[nid]) for nid in net.node_ids()]
+    assert all(len(b) == 1 for b in batches)
+    contribs = dict(batches[0][0].contributions)
+    assert set(contribs.keys()) == {0, 1, 2}
+
+
+def test_encryption_schedule_every_nth():
+    es = EncryptionSchedule.every_nth_epoch(3)
+    assert [es.encrypt_on_epoch(e) for e in range(6)] == [
+        True, False, False, True, False, False,
+    ]
+    tt = EncryptionSchedule.tick_tock(2, 1)
+    assert [tt.encrypt_on_epoch(e) for e in range(6)] == [
+        True, True, False, True, True, False,
+    ]

@@ -1,0 +1,94 @@
+"""ACS tests (reference: ``tests/subset.rs``): every correct node outputs the
+same set of ≥ N−f contributions."""
+
+import random
+
+import pytest
+
+from hbbft_tpu.netinfo import NetworkInfo
+from hbbft_tpu.protocols.subset import Contribution, Done, Subset
+from hbbft_tpu.sim import (
+    NetBuilder,
+    NullAdversary,
+    RandomAdversary,
+    ReorderingAdversary,
+)
+
+_INFO_CACHE = {}
+
+
+def infos_for(n, seed=11):
+    key = (n, seed)
+    if key not in _INFO_CACHE:
+        _INFO_CACHE[key] = NetworkInfo.generate_map(
+            list(range(n)), random.Random(seed)
+        )
+    return _INFO_CACHE[key]
+
+
+def run_subset(n, inputs, adversary):
+    infos = infos_for(n)
+    net = NetBuilder(list(range(n))).adversary(adversary).using_step(
+        lambda nid: Subset(infos[nid], b"subset-test")
+    )
+    for nid, v in inputs.items():
+        net.send_input(nid, v)
+    net.run_to_quiescence()
+    return net
+
+
+def contributions(node):
+    return {
+        o.proposer_id: o.value for o in node.outputs if isinstance(o, Contribution)
+    }
+
+
+@pytest.mark.parametrize(
+    "adv",
+    [NullAdversary(), ReorderingAdversary(seed=2), RandomAdversary(seed=3)],
+    ids=["null", "reordering", "random"],
+)
+@pytest.mark.parametrize("n", [1, 4])
+def test_all_propose_all_agree(n, adv):
+    inputs = {i: f"proposal-{i}".encode() for i in range(n)}
+    net = run_subset(n, inputs, adv)
+    f = (n - 1) // 3
+    sets = []
+    for nid in net.node_ids():
+        node = net.nodes[nid]
+        assert node.algorithm.terminated(), f"node {nid} not done"
+        assert isinstance(node.outputs[-1], Done)
+        contribs = contributions(node)
+        assert len(contribs) >= n - f
+        for pid, v in contribs.items():
+            assert v == inputs[pid]
+        sets.append(tuple(sorted(contribs.items())))
+    assert len(set(sets)) == 1, "nodes disagree on the subset"
+
+
+def test_one_silent_node_subset_excludes_it():
+    n = 4
+    inputs = {i: f"p{i}".encode() for i in range(n) if i != 3}  # node 3 silent
+    net = run_subset(n, inputs, NullAdversary())
+    for nid in net.node_ids():
+        node = net.nodes[nid]
+        assert node.algorithm.terminated()
+        contribs = contributions(node)
+        assert set(contribs.keys()) == {0, 1, 2}
+        assert len(contribs) >= n - 1 - (n - 1) // 3
+
+
+def test_subset_outputs_identical_across_seeds():
+    n = 4
+    inputs = {i: bytes([i]) * 30 for i in range(n)}
+    reference = None
+    for seed in range(3):
+        net = run_subset(n, inputs, RandomAdversary(seed=seed))
+        this = {
+            nid: tuple(sorted(contributions(net.nodes[nid]).items()))
+            for nid in net.node_ids()
+        }
+        vals = set(this.values())
+        assert len(vals) == 1
+        if reference is None:
+            reference = vals

@@ -1,0 +1,74 @@
+"""ThresholdSign protocol tests (reference: ``tests/threshold_sign.rs``)."""
+
+import random
+
+import pytest
+
+from hbbft_tpu.crypto import tc
+from hbbft_tpu.fault_log import FaultKind
+from hbbft_tpu.netinfo import NetworkInfo
+from hbbft_tpu.protocols.threshold_sign import ThresholdSign, ThresholdSignMessage
+from hbbft_tpu.sim import NetBuilder, NullAdversary, RandomAdversary
+
+
+def run_sign(n, adversary, doc=b"sign me", optimistic=True, rng_seed=1):
+    rng = random.Random(rng_seed)
+    infos = NetworkInfo.generate_map(list(range(n)), rng)
+    net = NetBuilder(list(range(n))).adversary(adversary).using_step(
+        lambda nid: ThresholdSign(infos[nid], optimistic=optimistic)
+    )
+    for nid in net.node_ids():
+        net.send_input(nid, doc)
+    net.run_to_quiescence()
+    return net
+
+
+@pytest.mark.parametrize("n", [1, 4, 7])
+@pytest.mark.parametrize("optimistic", [True, False])
+def test_all_nodes_same_signature(n, optimistic):
+    net = run_sign(n, NullAdversary(), optimistic=optimistic)
+    sigs = [net.nodes[nid].outputs for nid in net.node_ids()]
+    assert all(len(s) == 1 for s in sigs)
+    assert len({s[0].to_bytes() for s in sigs}) == 1  # unique signature
+    assert all(net.nodes[nid].algorithm.terminated() for nid in net.node_ids())
+
+
+def test_random_schedule():
+    net = run_sign(4, RandomAdversary(seed=9, dup_prob=0.1))
+    sigs = {net.nodes[nid].outputs[0].to_bytes() for nid in net.node_ids()}
+    assert len(sigs) == 1
+
+
+def test_share_before_document_is_buffered(rng):
+    infos = NetworkInfo.generate_map([0, 1, 2, 3], rng)
+    ts0 = ThresholdSign(infos[0])
+    ts1 = ThresholdSign(infos[1])
+    step1 = ts1.handle_input(b"doc")
+    share_msg = step1.messages[0].message
+    # deliver to ts0 before it knows the document
+    assert ts0.handle_message(1, share_msg).output == []
+    # now set the document: the buffered share is processed
+    ts0.handle_input(b"doc")
+    assert len(ts0.shares) >= 2  # own + buffered
+
+
+def test_invalid_share_is_faulted_and_excluded(rng):
+    infos = NetworkInfo.generate_map([0, 1, 2, 3], rng)
+    ts0 = ThresholdSign(infos[0])
+    ts0.handle_input(b"doc")
+    # node 1 sends garbage (a share signed with the wrong key)
+    bad = infos[1].secret_key_share()  # valid key...
+    wrong = tc.SecretKeyShare(12345)  # ...but sign with junk
+    step = ts0.handle_message(1, ThresholdSignMessage(wrong.sign(b"doc")))
+    # optimistic: combine of {0,1} fails -> fallback evicts node 1
+    assert any(
+        f.node_id == 1 and f.kind == FaultKind.InvalidSignatureShare
+        for f in step.fault_log
+    )
+    assert ts0.signature is None
+    # two more honest shares arrive -> signature completes
+    for nid in (2, 3):
+        share = infos[nid].secret_key_share().sign(b"doc")
+        step = ts0.handle_message(nid, ThresholdSignMessage(share))
+    assert ts0.signature is not None
+    assert infos[0].public_key_set().verify_signature(ts0.signature, b"doc")
