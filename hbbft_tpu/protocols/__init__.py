@@ -13,4 +13,50 @@ re-expresses as dense tensors.  Reference layout: ``src/`` of poanetwork/hbbft
 (see SURVEY.md §1-§3).
 """
 
+from hbbft_tpu.protocols.binary_agreement import BinaryAgreement
 from hbbft_tpu.protocols.broadcast import Broadcast
+from hbbft_tpu.protocols.dynamic_honey_badger import (
+    Change,
+    ChangeState,
+    DhbBatch,
+    DynamicHoneyBadger,
+    JoinPlan,
+)
+from hbbft_tpu.protocols.honey_badger import (
+    Batch,
+    EncryptionSchedule,
+    HoneyBadger,
+    HoneyBadgerBuilder,
+)
+from hbbft_tpu.protocols.queueing_honey_badger import (
+    QhbBatch,
+    QueueingHoneyBadger,
+    TransactionQueue,
+)
+from hbbft_tpu.protocols.sender_queue import SenderQueue
+from hbbft_tpu.protocols.subset import Subset
+from hbbft_tpu.protocols.sync_key_gen import SyncKeyGen
+from hbbft_tpu.protocols.threshold_decrypt import ThresholdDecrypt
+from hbbft_tpu.protocols.threshold_sign import ThresholdSign
+
+__all__ = [
+    "BinaryAgreement",
+    "Broadcast",
+    "Change",
+    "ChangeState",
+    "DhbBatch",
+    "DynamicHoneyBadger",
+    "JoinPlan",
+    "Batch",
+    "EncryptionSchedule",
+    "HoneyBadger",
+    "HoneyBadgerBuilder",
+    "QhbBatch",
+    "QueueingHoneyBadger",
+    "TransactionQueue",
+    "SenderQueue",
+    "Subset",
+    "SyncKeyGen",
+    "ThresholdDecrypt",
+    "ThresholdSign",
+]

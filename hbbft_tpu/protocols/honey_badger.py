@@ -357,5 +357,6 @@ class HoneyBadger(ConsensusProtocol):
             batch = self.completed.pop(self.epoch)
             step.output.append(batch)
             del self.epochs[self.epoch]
+            self.has_input.pop(self.epoch, None)  # bound per-epoch state
             self.epoch += 1
         return step

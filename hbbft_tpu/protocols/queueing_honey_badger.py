@@ -1,0 +1,211 @@
+"""Queueing HoneyBadger: the user-facing transaction buffer.
+
+Reference: ``src/queueing_honey_badger/`` + ``src/transaction_queue.rs`` —
+wraps ``DynamicHoneyBadger`` with a transaction queue: user transactions are
+buffered; each epoch the node proposes a *random sample* of ``batch_size``
+transactions (random so that distinct nodes' proposals overlap little —
+the HoneyBadger paper's throughput trick); committed transactions are removed
+everywhere; leftovers are re-proposed.
+
+Divergence from the reference worth knowing: a node with an empty queue also
+proposes an empty contribution once it sees consensus activity for the
+current epoch, so epochs complete without requiring ≥ N−f non-empty queues.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Dict, Hashable, List, Optional, Sequence, Tuple
+
+from hbbft_tpu.fault_log import FaultKind
+from hbbft_tpu.netinfo import NetworkInfo
+from hbbft_tpu.protocols import wire
+from hbbft_tpu.protocols.dynamic_honey_badger import (
+    Change,
+    ChangeInput,
+    ChangeState,
+    DhbBatch,
+    DynamicHoneyBadger,
+    HbWrap,
+    UserInput,
+)
+from hbbft_tpu.traits import ConsensusProtocol, Step
+
+NodeId = Hashable
+
+
+class TransactionQueue:
+    """Reference: ``src/transaction_queue.rs :: trait TransactionQueue``.
+
+    Random sampling (``choose``) keeps different nodes' batch proposals
+    mostly disjoint, which is what makes N proposals per epoch add up to
+    N× throughput instead of N× duplication.
+    """
+
+    def __init__(self):
+        self._txs: List[bytes] = []
+        self._set: Dict[bytes, int] = {}
+
+    def extend(self, txs: Sequence[bytes]) -> None:
+        for tx in txs:
+            tx = bytes(tx)
+            if tx not in self._set:
+                self._set[tx] = 1
+                self._txs.append(tx)
+
+    def remove_multiple(self, txs: Sequence[bytes]) -> None:
+        drop = {bytes(t) for t in txs}
+        if not drop:
+            return
+        self._txs = [t for t in self._txs if t not in drop]
+        for t in drop:
+            self._set.pop(t, None)
+
+    def choose(self, rng: random.Random, amount: int) -> List[bytes]:
+        if amount >= len(self._txs):
+            return list(self._txs)
+        return rng.sample(self._txs, amount)
+
+    def __len__(self) -> int:
+        return len(self._txs)
+
+
+def _ser_txs(txs: Sequence[bytes]) -> bytes:
+    out = wire.u32(len(txs))
+    for tx in txs:
+        out += wire.blob(tx)
+    return out
+
+
+def _de_txs(data: bytes) -> Tuple[bytes, ...]:
+    r = wire.Reader(data)
+    n = r.u32()
+    if n > 1_000_000:
+        raise ValueError("absurd tx count")
+    return tuple(r.blob() for _ in range(n))
+
+
+@dataclass(frozen=True)
+class QhbBatch:
+    """A committed batch of transactions (decoded DHB batch)."""
+
+    era: int
+    epoch: int
+    contributions: Tuple[Tuple[NodeId, Tuple[bytes, ...]], ...]
+    change: ChangeState
+
+    def all_txs(self) -> List[bytes]:
+        out = []
+        seen = set()
+        for _, txs in self.contributions:
+            for tx in txs:
+                if tx not in seen:
+                    seen.add(tx)
+                    out.append(tx)
+        return out
+
+
+@dataclass(frozen=True)
+class TxInput:
+    tx: bytes
+
+
+class QueueingHoneyBadger(ConsensusProtocol):
+    """Reference: ``queueing_honey_badger.rs :: QueueingHoneyBadger<T,N,Q>``."""
+
+    def __init__(
+        self,
+        dhb: DynamicHoneyBadger,
+        batch_size: int = 100,
+        rng: Optional[random.Random] = None,
+        queue: Optional[TransactionQueue] = None,
+    ):
+        self.dhb = dhb
+        self.batch_size = batch_size
+        self.rng = rng or random.Random(0)
+        self.queue = queue or TransactionQueue()
+        self.dhb.empty_contribution = _ser_txs([])
+        # DHB's DKG keep-alive proposes REAL transactions, not empties
+        self.dhb.contribution_provider = lambda: _ser_txs(
+            self.queue.choose(self.rng, self.batch_size)
+        )
+
+    # -- ConsensusProtocol ---------------------------------------------------
+
+    def our_id(self) -> NodeId:
+        return self.dhb.our_id()
+
+    def terminated(self) -> bool:
+        return False
+
+    def handle_input(self, input) -> Step:
+        if isinstance(input, TxInput):
+            return self.push_transaction(input.tx)
+        if isinstance(input, ChangeInput):
+            step = self.dhb.vote_for(input.change)
+            return step.extend(self._maybe_propose(force=True))
+        raise TypeError(f"unknown QHB input {input!r}")
+
+    def push_transaction(self, tx: bytes) -> Step:
+        """Buffer a transaction and propose if we haven't this epoch."""
+        self.queue.extend([tx])
+        return self._maybe_propose(force=True)
+
+    def handle_message(self, sender_id: NodeId, message) -> Step:
+        step = self._process(self.dhb.handle_message(sender_id, message))
+        # if consensus activity exists for the current epoch and we haven't
+        # proposed, contribute (possibly an empty sample) to keep it live
+        if (
+            isinstance(message, HbWrap)
+            and message.era == self.dhb.era
+            and self.dhb.hb.epoch in self.dhb.hb.epochs
+        ):
+            step.extend(self._maybe_propose(force=True))
+        return step
+
+    # -- internals -----------------------------------------------------------
+
+    def _maybe_propose(self, force: bool = False) -> Step:
+        if not self.dhb.is_validator():
+            return Step()
+        if self.dhb.hb.has_input.get(self.dhb.hb.epoch):
+            return Step()
+        if not force and len(self.queue) == 0:
+            return Step()
+        sample = self.queue.choose(self.rng, self.batch_size)
+        return self._process(self.dhb.propose(_ser_txs(sample)))
+
+    def _process(self, inner: Step) -> Step:
+        """Decode DHB batches into tx batches and update the queue."""
+        step = Step(
+            fault_log=inner.fault_log, messages=inner.messages
+        )
+        for out in inner.output:
+            if not isinstance(out, DhbBatch):
+                continue
+            contribs: List[Tuple[NodeId, Tuple[bytes, ...]]] = []
+            committed: List[bytes] = []
+            for proposer, payload in out.contributions:
+                try:
+                    txs = _de_txs(payload)
+                except ValueError:
+                    step.fault(
+                        proposer, FaultKind.BatchDeserializationFailed
+                    )
+                    continue
+                contribs.append((proposer, txs))
+                committed.extend(txs)
+            self.queue.remove_multiple(committed)
+            step.output.append(
+                QhbBatch(
+                    era=out.era,
+                    epoch=out.epoch,
+                    contributions=tuple(contribs),
+                    change=out.change,
+                )
+            )
+        # a batch completed → next epoch began: re-propose leftovers
+        if step.output:
+            step.extend(self._maybe_propose())
+        return step

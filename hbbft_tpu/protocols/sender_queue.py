@@ -1,0 +1,174 @@
+"""SenderQueue: buffer messages for peers in earlier epochs.
+
+Reference: ``src/sender_queue/`` — wraps HoneyBadger/DHB/QHB so that
+messages addressed to a peer that has not yet reached the message's epoch
+are held back until the peer announces (via ``EpochStarted``) that it can
+process them, bounding "future epoch" drops/faults on real networks where
+nodes progress at different speeds.
+
+Epoch keys are (era, epoch) tuples ordered lexicographically; plain
+HoneyBadger uses era 0.  A message is deliverable to a peer once
+``msg_key ≤ (peer_era, peer_epoch + max_future_epochs)``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
+
+from hbbft_tpu.protocols.dynamic_honey_badger import (
+    DhbBatch,
+    DynamicHoneyBadger,
+    HbWrap,
+    KeyGenWrap,
+)
+from hbbft_tpu.protocols.honey_badger import (
+    Batch as HbBatch,
+    DecryptionShareWrap,
+    HoneyBadger,
+    SubsetWrap,
+)
+from hbbft_tpu.protocols.queueing_honey_badger import QhbBatch, QueueingHoneyBadger
+from hbbft_tpu.traits import ConsensusProtocol, Step, Target, TargetedMessage
+
+NodeId = Hashable
+EpochKey = Tuple[int, int]
+
+
+@dataclass(frozen=True)
+class EpochStarted:
+    key: EpochKey
+
+
+@dataclass(frozen=True)
+class AlgoMessage:
+    msg: Any
+
+
+def _message_key(msg: Any) -> Optional[EpochKey]:
+    """The (era, epoch) a message belongs to, or None if always deliverable."""
+    if isinstance(msg, (SubsetWrap, DecryptionShareWrap)):
+        return (0, msg.epoch)
+    if isinstance(msg, HbWrap):
+        inner = msg.msg
+        ep = getattr(inner, "epoch", 0)
+        return (msg.era, ep)
+    if isinstance(msg, KeyGenWrap):
+        return (msg.era, 0)
+    return None
+
+
+def _algo_key(algo: Any) -> EpochKey:
+    if isinstance(algo, QueueingHoneyBadger):
+        return (algo.dhb.era, algo.dhb.hb.epoch)
+    if isinstance(algo, DynamicHoneyBadger):
+        return (algo.era, algo.hb.epoch)
+    if isinstance(algo, HoneyBadger):
+        return (0, algo.epoch)
+    raise TypeError(f"SenderQueue cannot wrap {type(algo)!r}")
+
+
+def _algo_window(algo: Any) -> int:
+    if isinstance(algo, QueueingHoneyBadger):
+        return algo.dhb.max_future_epochs
+    if isinstance(algo, DynamicHoneyBadger):
+        return algo.max_future_epochs
+    return algo.max_future_epochs
+
+
+class SenderQueue(ConsensusProtocol):
+    """Reference: ``src/sender_queue/mod.rs :: SenderQueue<D>``."""
+
+    def __init__(self, algo: Any):
+        self.algo = algo
+        self.peer_epochs: Dict[NodeId, EpochKey] = {}
+        # per-peer buffered (key, message)
+        self.buffered: Dict[NodeId, List[Tuple[EpochKey, Any]]] = {}
+        self.last_announced: Optional[EpochKey] = None
+
+    def startup_step(self) -> Step:
+        """Announce our epoch so peers learn we exist.
+
+        An observer/candidate is not in the validators' ``netinfo``, so their
+        SenderQueues would never address it; its ``EpochStarted`` broadcast
+        registers it with every peer (reference: the sender queue's peer
+        transitions).  Call once when joining a network.
+        """
+        cur = _algo_key(self.algo)
+        self.last_announced = cur
+        return Step().send(Target.all(), EpochStarted(cur))
+
+    # -- ConsensusProtocol ---------------------------------------------------
+
+    def our_id(self) -> NodeId:
+        return self.algo.our_id()
+
+    def terminated(self) -> bool:
+        return self.algo.terminated()
+
+    def handle_input(self, input) -> Step:
+        return self._post(self.algo.handle_input(input))
+
+    def handle_message(self, sender_id: NodeId, message) -> Step:
+        if isinstance(message, EpochStarted):
+            return self._peer_advanced(sender_id, message.key)
+        if isinstance(message, AlgoMessage):
+            return self._post(self.algo.handle_message(sender_id, message.msg))
+        raise TypeError(f"unknown sender_queue message {message!r}")
+
+    # -- internals -----------------------------------------------------------
+
+    def _deliverable(self, key: Optional[EpochKey], peer: NodeId) -> bool:
+        if key is None:
+            return True
+        era, epoch = self.peer_epochs.get(peer, (0, 0))
+        window = _algo_window(self.algo)
+        return key <= (era, epoch + window)
+
+    def _peer_advanced(self, peer: NodeId, key: EpochKey) -> Step:
+        cur = self.peer_epochs.get(peer)
+        if cur is not None and key <= cur:
+            return Step()
+        self.peer_epochs[peer] = key  # also registers unknown observers
+        step = Step()
+        held = self.buffered.pop(peer, [])
+        keep: List[Tuple[EpochKey, Any]] = []
+        for mkey, msg in held:
+            if self._deliverable(mkey, peer):
+                step.send_to(peer, AlgoMessage(msg))
+            else:
+                keep.append((mkey, msg))
+        if keep:
+            self.buffered[peer] = keep
+        return step
+
+    def _post(self, inner: Step) -> Step:
+        """Wrap outgoing messages, buffering ones their target can't use yet,
+        and announce our own epoch transitions."""
+        step = Step(output=inner.output, fault_log=inner.fault_log)
+        peers = [n for n in self._known_peers() if n != self.our_id()]
+        for tm in inner.messages:
+            key = _message_key(tm.message)
+            for peer in peers:
+                if not tm.target.contains(peer):
+                    continue
+                if self._deliverable(key, peer):
+                    step.send_to(peer, AlgoMessage(tm.message))
+                else:
+                    self.buffered.setdefault(peer, []).append(
+                        (key, tm.message)
+                    )
+        cur = _algo_key(self.algo)
+        if self.last_announced is None or cur > self.last_announced:
+            self.last_announced = cur
+            step.send(Target.all(), EpochStarted(cur))
+        return step
+
+    def _known_peers(self) -> List[NodeId]:
+        netinfo = (
+            self.algo.dhb.netinfo
+            if isinstance(self.algo, QueueingHoneyBadger)
+            else self.algo.netinfo
+        )
+        known = set(netinfo.all_ids()) | set(self.peer_epochs.keys())
+        return sorted(known, key=repr)

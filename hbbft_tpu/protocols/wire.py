@@ -1,0 +1,119 @@
+"""Canonical byte codec for consensus-committed payloads.
+
+The reference uses ``bincode``+serde at this boundary (SURVEY §2.2); we use
+an explicit deterministic tag-length-value codec.  Everything that goes
+*inside* a HoneyBadger contribution (votes, key-gen messages, user payloads)
+must be bytes, because contributions are TPKE-encrypted and RBC-sharded.
+
+Node ids are restricted to ints and strings on the wire (tests and the
+simulator use ints; deployments use strings).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Hashable, List, Optional, Tuple
+
+from hbbft_tpu.crypto import tc
+
+NodeId = Hashable
+
+
+class Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise ValueError("truncated")
+        out = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+    def u32(self) -> int:
+        return struct.unpack(">I", self.take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack(">Q", self.take(8))[0]
+
+    def blob(self) -> bytes:
+        return self.take(self.u32())
+
+    def done(self) -> bool:
+        return self.pos == len(self.data)
+
+
+def blob(b: bytes) -> bytes:
+    return struct.pack(">I", len(b)) + b
+
+
+def u32(v: int) -> bytes:
+    return struct.pack(">I", v)
+
+
+def u64(v: int) -> bytes:
+    return struct.pack(">Q", v)
+
+
+# -- node ids ---------------------------------------------------------------
+
+
+def node_id(nid: NodeId) -> bytes:
+    if isinstance(nid, bool) or not isinstance(nid, (int, str)):
+        raise TypeError(f"wire node ids must be int or str, got {nid!r}")
+    if isinstance(nid, int):
+        return b"\x01" + struct.pack(">q", nid)
+    enc = nid.encode()
+    return b"\x02" + blob(enc)
+
+
+def read_node_id(r: Reader) -> NodeId:
+    tag = r.take(1)
+    if tag == b"\x01":
+        return struct.unpack(">q", r.take(8))[0]
+    if tag == b"\x02":
+        return r.blob().decode()
+    raise ValueError("bad node id tag")
+
+
+# -- crypto objects ---------------------------------------------------------
+
+
+def ciphertext(ct: tc.Ciphertext) -> bytes:
+    return blob(ct.to_bytes())
+
+
+def read_ciphertext(r: Reader) -> tc.Ciphertext:
+    return tc.Ciphertext.from_bytes(r.blob())
+
+
+def signature(sig: tc.Signature) -> bytes:
+    return blob(sig.to_bytes())
+
+
+def read_signature(r: Reader) -> tc.Signature:
+    return tc.Signature.from_bytes(r.blob())
+
+
+def commitment_bivar(com: tc.BivarCommitment) -> bytes:
+    from hbbft_tpu.crypto import bls12_381 as bls
+
+    out = u32(com.degree())
+    for row in com.points:
+        for p in row:
+            out += bls.g1_to_bytes(p)
+    return out
+
+
+def read_commitment_bivar(r: Reader) -> tc.BivarCommitment:
+    from hbbft_tpu.crypto import bls12_381 as bls
+
+    degree = r.u32()
+    if degree > 1024:
+        raise ValueError("absurd commitment degree")
+    pts = [
+        [bls.g1_from_bytes(r.take(97)) for _ in range(degree + 1)]
+        for _ in range(degree + 1)
+    ]
+    return tc.BivarCommitment(degree, pts)

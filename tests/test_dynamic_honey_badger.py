@@ -1,0 +1,157 @@
+"""DynamicHoneyBadger tests (reference: ``tests/dynamic_honey_badger.rs`` /
+``net_dynamic_hb.rs``): add a validator via JoinPlan + DKG, remove one,
+change the encryption schedule — mid-run, through consensus."""
+
+import random
+
+import pytest
+
+from hbbft_tpu.crypto import tc
+from hbbft_tpu.netinfo import NetworkInfo
+from hbbft_tpu.protocols.dynamic_honey_badger import (
+    Change,
+    ChangeInput,
+    ChangeState,
+    DhbBatch,
+    DynamicHoneyBadger,
+    UserInput,
+)
+from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
+from hbbft_tpu.sim import NetBuilder, NullAdversary
+
+
+def make_network(n, seed=31, schedule=None):
+    rng = random.Random(seed)
+    infos = NetworkInfo.generate_map(list(range(n)), rng)
+    sec_keys = {nid: infos[nid].secret_key() for nid in infos}
+    net = NetBuilder(list(range(n))).using_step(
+        lambda nid: DynamicHoneyBadger(
+            infos[nid],
+            sec_keys[nid],
+            rng=random.Random(5000 + nid),
+            encryption_schedule=schedule or EncryptionSchedule.never(),
+        )
+    )
+    return net
+
+
+def batches_of(node):
+    return [o for o in node.outputs if isinstance(o, DhbBatch)]
+
+
+def drive_epoch(net, payload_fn, validators=None):
+    ids = validators if validators is not None else net.node_ids()
+    for nid in ids:
+        net.send_input(nid, UserInput(payload_fn(nid)))
+    net.run_to_quiescence()
+
+
+def test_plain_epochs_without_changes():
+    net = make_network(4)
+    drive_epoch(net, lambda nid: f"user-{nid}".encode())
+    for nid in net.node_ids():
+        bs = batches_of(net.nodes[nid])
+        assert len(bs) == 1
+        assert bs[0].era == 0 and bs[0].change.state == "none"
+    ref = batches_of(net.nodes[0])
+    assert all(batches_of(net.nodes[nid]) == ref for nid in net.node_ids())
+
+
+def test_remove_validator_rotates_era():
+    net = make_network(4)
+    # everyone votes to remove node 3, then proposes (committing the votes)
+    for nid in net.node_ids():
+        net.send_input(nid, ChangeInput(
+            Change.node_change({
+                k: net.nodes[nid].algorithm.netinfo.public_key(k)
+                for k in (0, 1, 2)
+            })
+        ))
+    drive_epoch(net, lambda nid: b"payload")
+    # drive until era rotates everywhere (DKG runs through batches)
+    for _ in range(6):
+        if all(net.nodes[nid].algorithm.era == 1 for nid in net.node_ids()):
+            break
+        drive_epoch(net, lambda nid: b"more")
+    for nid in net.node_ids():
+        algo = net.nodes[nid].algorithm
+        assert algo.era == 1, f"node {nid} stuck in era {algo.era}"
+        assert sorted(algo.netinfo.all_ids()) == [0, 1, 2]
+    # removed node is no longer a validator; the rest are
+    assert not net.nodes[3].algorithm.is_validator()
+    assert all(net.nodes[nid].algorithm.is_validator() for nid in (0, 1, 2))
+    # a Complete batch was reported with the change
+    completes = [
+        b for b in batches_of(net.nodes[0]) if b.change.state == "complete"
+    ]
+    assert completes and completes[0].change.change.kind == "nodes"
+    # consensus still works in the new era among 0,1,2
+    drive_epoch(net, lambda nid: f"era1-{nid}".encode(), validators=[0, 1, 2])
+    era1 = [b for b in batches_of(net.nodes[0]) if b.era == 1 and b.contributions]
+    assert era1, "no era-1 batch committed"
+    for nid in (1, 2, 3):
+        got = [b for b in batches_of(net.nodes[nid]) if b.era == 1 and b.contributions]
+        assert got == era1  # node 3 still observes identically
+
+
+def test_add_validator_via_join_plan():
+    net = make_network(4)
+    rng = random.Random(99)
+    # candidate node 4 with a fresh plain keypair
+    cand_sk = tc.SecretKey.random(rng)
+    cand_pk = cand_sk.public_key()
+    plan = net.nodes[0].algorithm.join_plan()
+    from hbbft_tpu.sim.virtual_net import Node
+
+    cand_algo = DynamicHoneyBadger.from_join_plan(
+        4, cand_sk, plan, rng=random.Random(5004)
+    )
+    net.nodes[4] = Node(node_id=4, algorithm=cand_algo)
+    assert not cand_algo.is_validator()
+    # validators vote to add node 4
+    for nid in (0, 1, 2, 3):
+        algo = net.nodes[nid].algorithm
+        net.send_input(nid, ChangeInput(
+            Change.node_change(
+                {**algo.netinfo.public_key_map(), 4: cand_pk}
+            )
+        ))
+    drive_epoch(net, lambda nid: b"x", validators=[0, 1, 2, 3])
+    for _ in range(8):
+        if all(
+            net.nodes[nid].algorithm.era == 1 for nid in net.node_ids()
+        ):
+            break
+        drive_epoch(net, lambda nid: b"y", validators=[0, 1, 2, 3])
+    for nid in net.node_ids():
+        algo = net.nodes[nid].algorithm
+        assert algo.era == 1, f"node {nid} stuck in era {algo.era}"
+        assert sorted(algo.netinfo.all_ids()) == [0, 1, 2, 3, 4]
+    # the candidate became a real validator with a working key share
+    assert net.nodes[4].algorithm.is_validator()
+    # and can now contribute to consensus
+    drive_epoch(net, lambda nid: f"from-{nid}".encode())
+    era1 = [
+        b for b in batches_of(net.nodes[0]) if b.era == 1 and b.contributions
+    ]
+    assert era1
+    contribs = era1[0].contributions_map()
+    ref = [b for b in batches_of(net.nodes[4]) if b.era == 1 and b.contributions]
+    assert ref == era1
+
+
+def test_encryption_schedule_change():
+    net = make_network(4, schedule=EncryptionSchedule.never())
+    es = EncryptionSchedule.every_nth_epoch(2)
+    for nid in net.node_ids():
+        net.send_input(nid, ChangeInput(Change.encryption_schedule(es)))
+    drive_epoch(net, lambda nid: b"z")
+    for _ in range(4):
+        if all(net.nodes[nid].algorithm.era == 1 for nid in net.node_ids()):
+            break
+        drive_epoch(net, lambda nid: b"w")
+    for nid in net.node_ids():
+        algo = net.nodes[nid].algorithm
+        assert algo.era == 1
+        assert algo.encryption_schedule.kind == "nth"
+        assert algo.is_validator()  # same keys, new era

@@ -1,0 +1,108 @@
+"""DKG tests (reference: ``tests/sync_key_gen.rs``): run the protocol among
+n parties in-process, then sign/decrypt with the generated shares."""
+
+import random
+
+import pytest
+
+from hbbft_tpu.crypto import tc
+from hbbft_tpu.protocols.sync_key_gen import Ack, Part, SyncKeyGen
+
+
+def run_dkg(n, t, rng, dealers=None, observer=False):
+    ids = list(range(n))
+    sec_keys = {i: tc.SecretKey.random(rng) for i in ids}
+    pub_keys = {i: sk.public_key() for i, sk in sec_keys.items()}
+    nodes = {
+        i: SyncKeyGen(i, sec_keys[i], pub_keys, t, random.Random(rng.getrandbits(64)))
+        for i in ids
+    }
+    if observer:
+        nodes["obs"] = SyncKeyGen(
+            "obs", tc.SecretKey.random(rng), pub_keys, t, rng
+        )
+    dealers = dealers if dealers is not None else ids
+    # deal parts, everyone handles them in the same order, acks likewise
+    acks = []
+    for d in dealers:
+        part = nodes[d].generate_part()
+        for nid, node in nodes.items():
+            outcome = node.handle_part(d, part)
+            assert outcome.fault is None, (nid, d, outcome.fault)
+            if outcome.ack is not None:
+                acks.append((nid, outcome.ack))
+    for acker, ack in acks:
+        for nid, node in nodes.items():
+            outcome = node.handle_ack(acker, ack)
+            assert outcome.fault is None, (nid, acker, outcome.fault)
+    return nodes
+
+
+@pytest.mark.parametrize("n,t", [(4, 1), (7, 2)])
+def test_dkg_roundtrip(n, t, rng):
+    nodes = run_dkg(n, t, rng)
+    assert all(node.is_ready() for node in nodes.values())
+    results = {i: nodes[i].generate() for i in range(n)}
+    pk_sets = {r[0].to_bytes() for r in results.values()}
+    assert len(pk_sets) == 1, "nodes derived different public key sets"
+    pks = results[0][0]
+    assert pks.threshold() == t
+    # threshold signature with the generated shares
+    msg = b"post-dkg signing"
+    shares = {
+        i: results[i][1].sign(msg) for i in range(t + 1)
+    }
+    sig = pks.combine_signatures(shares)
+    assert pks.verify_signature(sig, msg)
+    # share indices must line up with PublicKeySet.public_key_share
+    for i in range(n):
+        assert pks.verify_signature_share(i, results[i][1].sign(msg), msg)
+    # TPKE with the generated keys
+    ct = pks.public_key().encrypt(b"secret", rng)
+    dshares = {i: results[i][1].decrypt_share(ct) for i in (0, n - 1)}
+    if t == 1:
+        assert pks.decrypt(dshares, ct) == b"secret"
+
+
+def test_dkg_subset_of_dealers(rng):
+    """Only t+1 dealers deal — still ready, same keys on all nodes."""
+    n, t = 4, 1
+    nodes = run_dkg(n, t, rng, dealers=[1, 3])
+    assert all(node.is_ready() for node in nodes.values())
+    pk_sets = {nodes[i].generate()[0].to_bytes() for i in range(n)}
+    assert len(pk_sets) == 1
+
+
+def test_dkg_observer_follows(rng):
+    """A non-member observer tracks the DKG and derives the public keys."""
+    n, t = 4, 1
+    nodes = run_dkg(n, t, rng, observer=True)
+    obs = nodes["obs"]
+    assert obs.is_ready()
+    pks_obs, share = obs.generate()
+    assert share is None  # observers get no secret share
+    assert pks_obs.to_bytes() == nodes[0].generate()[0].to_bytes()
+
+
+def test_dkg_bad_part_detected(rng):
+    n, t = 4, 1
+    ids = list(range(n))
+    sec_keys = {i: tc.SecretKey.random(rng) for i in ids}
+    pub_keys = {i: sk.public_key() for i, sk in sec_keys.items()}
+    node0 = SyncKeyGen(0, sec_keys[0], pub_keys, t, rng)
+    node1 = SyncKeyGen(1, sec_keys[1], pub_keys, t, rng)
+    part = node1.generate_part()
+    # tamper: swap two encrypted rows → row check must fail somewhere
+    bad = Part(part.commitment, (part.rows[1], part.rows[0]) + part.rows[2:])
+    outcome = node0.handle_part(1, bad)
+    assert outcome.fault is not None
+
+
+def test_dkg_not_ready_raises(rng):
+    n, t = 4, 1
+    ids = list(range(n))
+    sec_keys = {i: tc.SecretKey.random(rng) for i in ids}
+    pub_keys = {i: sk.public_key() for i, sk in sec_keys.items()}
+    node0 = SyncKeyGen(0, sec_keys[0], pub_keys, t, rng)
+    with pytest.raises(ValueError):
+        node0.generate()
