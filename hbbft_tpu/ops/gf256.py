@@ -167,10 +167,12 @@ def bits_to_bytes(bits):
 
 
 def gf_apply_bitmatrix(data, bitmat):
-    """Apply a constant GF(2^8) matrix to byte data on device.
+    """Apply a GF(2^8) matrix to byte data on device.
 
     data: uint8 (..., B, k) — B byte-positions × k input symbols.
-    bitmat: int8 (k*8, r*8) from :func:`gf_matrix_to_bits` (constant).
+    bitmat: int8 (k*8, r*8) from :func:`gf_matrix_to_bits` (constant), or a
+    batched (..., k*8, r*8) from :func:`gf_matrix_to_bits_jnp` with leading
+    dims broadcast-compatible with ``data`` (``jnp.matmul`` batches it).
     Returns uint8 (..., B, r).
 
     The contraction is an int8×int8→int32 matmul — on TPU this is a single
@@ -196,3 +198,90 @@ def gf_mul_jnp(a, b):
     r = exp[(log[a] + log[b]) % 255]
     nz = (a != 0) & (b != 0)
     return jnp.where(nz, r, 0).astype(jnp.uint8)
+
+
+def gf_inv_jnp(a):
+    """Elementwise GF(2^8) inverse on device; maps 0 → 0 (caller masks)."""
+    import jax.numpy as jnp
+
+    exp = jnp.asarray(GF_EXP)
+    log = jnp.asarray(GF_LOG)
+    r = exp[255 - log[a]]
+    return jnp.where(a != 0, r, 0).astype(jnp.uint8)
+
+
+def gf_inv_matrix_jnp(M):
+    """Batched GF(2^8) matrix inversion on device (Gauss–Jordan).
+
+    M: uint8 (..., n, n) — data-dependent matrices (e.g. the encode-matrix
+    rows of each receiver's surviving shard set, which differ per (node,
+    proposer) under an adversarial drop pattern, so they must be inverted on
+    device).  Returns ``(inv, ok)`` with ``ok`` bool (...,) false for
+    singular inputs (their ``inv`` content is garbage; caller masks).
+
+    The column loop is a ``lax.fori_loop`` (n is static, tiny); every step is
+    vectorized over the batch.  Partial pivoting picks the first nonzero
+    entry at-or-below the diagonal, exactly like the host
+    :func:`gf_inv_matrix_np`, so decode matrices are bit-identical.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    M = jnp.asarray(M, dtype=jnp.uint8)
+    n = M.shape[-1]
+    batch = M.shape[:-2]
+    eye = jnp.broadcast_to(jnp.eye(n, dtype=jnp.uint8), (*batch, n, n))
+    aug0 = jnp.concatenate([M, eye], axis=-1)  # (..., n, 2n)
+    rows = jnp.arange(n)
+
+    def body(col, carry):
+        aug, ok = carry
+        colvec = aug[..., :, col]  # (..., n)
+        cand = (colvec != 0) & (rows >= col)
+        ok = ok & jnp.any(cand, axis=-1)
+        piv = jnp.argmax(cand, axis=-1)  # first True (or 0 if none — masked)
+        # swap rows col ↔ piv via a per-batch permutation gather
+        idx = jnp.broadcast_to(rows, (*batch, n))
+        piv_b = piv[..., None]
+        perm = jnp.where(idx == col, piv_b, jnp.where(idx == piv_b, col, idx))
+        aug = jnp.take_along_axis(aug, perm[..., None], axis=-2)
+        # normalize the pivot row
+        pivot_row = aug[..., col, :]  # (..., 2n)
+        pinv = gf_inv_jnp(
+            jnp.take_along_axis(
+                aug[..., col], jnp.broadcast_to(col, (*batch, 1)), axis=-1
+            )
+        )  # (..., 1) — aug[..., col(row), col(column)]
+        pivot_row = gf_mul_jnp(pivot_row, pinv)
+        aug = jnp.moveaxis(
+            jnp.moveaxis(aug, -2, 0).at[col].set(pivot_row), 0, -2
+        )
+        # eliminate the column everywhere else
+        factors = aug[..., :, col]
+        factors = factors * (rows != col).astype(jnp.uint8)
+        aug = aug ^ gf_mul_jnp(factors[..., None], aug[..., col, :][..., None, :])
+        return aug, ok
+
+    ok0 = jnp.ones(batch, dtype=bool)
+    aug, ok = jax.lax.fori_loop(0, n, body, (aug0, ok0))
+    return aug[..., n:], ok
+
+
+def gf_matrix_to_bits_jnp(M):
+    """Device version of :func:`gf_matrix_to_bits`, batched.
+
+    M: uint8 (..., r, k) → int8 (..., k*8, r*8), same layout as the host
+    function (verified bit-identical in tests), for data-dependent matrices
+    such as per-(node, proposer) decode matrices.
+    """
+    import jax.numpy as jnp
+
+    r, k = M.shape[-2:]
+    powers = jnp.left_shift(jnp.uint8(1), jnp.arange(8, dtype=jnp.uint8))
+    prod = gf_mul_jnp(M[..., None], powers)  # (..., r, k, 8)
+    bits = (prod[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+    # (..., r, k, i, b) → (..., k, i, r, b) → (..., k*8, r*8)
+    A = jnp.moveaxis(bits, -4, -2)  # (..., k, i, r, b)
+    return A.reshape(*M.shape[:-2], k * 8, r * 8).astype(jnp.int8)
+
+

@@ -109,6 +109,7 @@ def keccak_f1600(hi, lo):
 
     hi, lo: uint32 arrays of shape (..., 25).
     """
+    import jax
     import jax.numpy as jnp
 
     src = jnp.asarray(_PI_SRC)
@@ -124,7 +125,9 @@ def keccak_f1600(hi, lo):
     def flat(h):
         return h.reshape(*h.shape[:-2], 25)
 
-    for rnd in range(24):
+    def round_fn(carry, rc):
+        hi, lo = carry
+        rc_hi, rc_lo = rc
         # θ — column parities
         Th, Tl = grid(hi), grid(lo)
         Ch = Th[..., 0, :] ^ Th[..., 1, :] ^ Th[..., 2, :] ^ Th[..., 3, :] ^ Th[..., 4, :]
@@ -143,8 +146,14 @@ def keccak_f1600(hi, lo):
         Tl = Tl ^ (~jnp.roll(Tl, -1, axis=-1) & jnp.roll(Tl, -2, axis=-1))
         hi, lo = flat(Th), flat(Tl)
         # ι
-        hi = hi.at[..., 0].set(hi[..., 0] ^ rcs_hi[rnd])
-        lo = lo.at[..., 0].set(lo[..., 0] ^ rcs_lo[rnd])
+        hi = hi.at[..., 0].set(hi[..., 0] ^ rc_hi)
+        lo = lo.at[..., 0].set(lo[..., 0] ^ rc_lo)
+        return (hi, lo), None
+
+    # lax.scan over the 24 rounds: the round body appears ONCE in the traced
+    # graph instead of 24× — keccak dominates every Merkle-heavy program's
+    # compile time, and merkle_build/verify instantiate sha3 per tree level.
+    (hi, lo), _ = jax.lax.scan(round_fn, (hi, lo), (rcs_hi, rcs_lo))
     return hi, lo
 
 

@@ -1,0 +1,19 @@
+"""Batched array-mode protocol execution — the TPU payoff.
+
+Object mode (``hbbft_tpu.protocols`` + ``hbbft_tpu.sim``) runs one message at
+a time through Python state machines: that is the reference semantics and the
+correctness oracle.  This package re-expresses protocol *rounds* as dense
+array programs over (receiver × sender × instance) axes — one jitted step per
+communication round, with adversarial drop/tamper schedules as mask arrays —
+so the whole network's round executes as a handful of MXU matmuls and batched
+keccak sweeps, and shards across TPU devices via ``shard_map`` with
+``all_gather``/``all_to_all`` playing the role of the network
+(SURVEY.md §2.3, §5 "distributed communication backend").
+
+Modules:
+- :mod:`hbbft_tpu.parallel.rbc` — batched Bracha reliable broadcast rounds.
+- :mod:`hbbft_tpu.parallel.mesh` — ``shard_map`` wrappers placing the node
+  axis across a device mesh.
+"""
+
+from hbbft_tpu.parallel.rbc import BatchedRbc  # noqa: F401

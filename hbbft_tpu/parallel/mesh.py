@@ -1,0 +1,98 @@
+"""``shard_map`` placement of batched protocol rounds on a device mesh.
+
+The scaling story (SURVEY.md §2.3): the node axis is the data-parallel axis.
+Each device owns a contiguous slice of nodes — it runs their proposer phase
+locally and their receiver phase locally; the *network* between the phases is
+an ``all_gather`` over the mesh axis (every node's proposal must reach every
+node — exactly RBC's Value/Echo fan-out), riding ICI between chips instead
+of a message queue.  Counting phases are replicated (they are O(N²·P) bool
+ops — noise); the heavy per-receiver decode work is sharded.
+
+The same function runs on a real multi-chip mesh or on the virtual
+`--xla_force_host_platform_device_count` CPU mesh used by tests and the
+driver's ``dryrun_multichip`` contract.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from hbbft_tpu.parallel.rbc import BatchedRbc
+
+
+def sharded_rbc_run(rbc: BatchedRbc, mesh, data, codeword_tamper=None,
+                    value_tamper=None, value_mask=None, echo_mask=None,
+                    ready_mask=None):
+    """Full batched RBC round with node axis sharded over ``mesh``.
+
+    ``data``: uint8 (P, k, B) with P == rbc.n divisible by the mesh size.
+    Masks/tampers as in :meth:`BatchedRbc.run` (replicated).
+
+    Returns the same dict as ``BatchedRbc.run`` with per-receiver arrays
+    gathered back to full size, so results are directly comparable with the
+    single-device path (tests assert bit-equality).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax import shard_map
+
+    n = rbc.n
+    (axis,) = mesh.axis_names
+    n_dev = mesh.devices.size
+    assert n % n_dev == 0, (n, n_dev)
+    per = n // n_dev
+
+    P_, k, B = data.shape
+    if codeword_tamper is None:
+        codeword_tamper = jnp.zeros((P_, n, B), dtype=jnp.uint8)
+    if value_tamper is None:
+        value_tamper = jnp.zeros((P_, n, B), dtype=jnp.uint8)
+    if value_mask is None:
+        value_mask = jnp.ones((P_, n), dtype=bool)
+    if echo_mask is None:
+        echo_mask = jnp.ones((n, n, P_), dtype=bool)
+    if ready_mask is None:
+        ready_mask = jnp.ones((n, n, P_), dtype=bool)
+
+    def step(d, cw, vt, vm, em, rm):
+        # d: local (per, k, B) — this device's proposers
+        shards, root, proofs, pmask = rbc.propose(d, cw)
+        shards = shards ^ vt
+        # the "network": every proposal reaches every node over ICI
+        shards = jax.lax.all_gather(shards, axis, tiled=True)   # (P, n, B)
+        root = jax.lax.all_gather(root, axis, tiled=True)       # (P, 32)
+        proofs = jax.lax.all_gather(proofs, axis, tiled=True)   # (P, n, D, 32)
+        # receiver phase for this device's slice of nodes
+        me = jax.lax.axis_index(axis)
+        receivers = me * per + jnp.arange(per)
+        out = rbc.run_from_proposal(
+            shards, root, proofs, pmask,
+            value_mask=vm, echo_mask=em, ready_mask=rm,
+            receivers=receivers,
+        )
+        return out
+
+    spec_p = P(axis)        # sharded over proposers/receivers (leading axis)
+    spec_r = P()            # replicated
+
+    in_specs = (spec_p, spec_p, spec_p, spec_r, spec_r, spec_r)
+    out_specs = {
+        "delivered": spec_p,
+        "fault": spec_p,
+        "data": spec_p,
+        "root": spec_r,
+        "echo_count": spec_p,
+        "ready_count": spec_p,
+    }
+
+    # check_vma off: the "root" output is replicated by construction (it is
+    # an all_gather result) but the checker can't see that through the
+    # data-dependent receiver phase.
+    fn = shard_map(
+        step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False,
+    )
+    return jax.jit(fn)(
+        data, codeword_tamper, value_tamper, value_mask, echo_mask, ready_mask
+    )

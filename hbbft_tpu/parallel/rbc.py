@@ -1,0 +1,278 @@
+"""Batched Bracha reliable broadcast as a dense array program.
+
+Reference semantics: ``src/broadcast/broadcast.rs`` (see the object-mode
+mirror in :mod:`hbbft_tpu.protocols.broadcast`).  Here one *communication
+round* of the whole network — N proposers × N receivers — executes as a
+single jitted computation over dense arrays (the bulk-synchronous
+over-approximation of SURVEY.md §5: every message of a round is "in flight"
+at once, and adversarial schedules are recovered via delivery-mask and
+tamper arrays instead of a message queue).
+
+Axes: ``P`` proposers (RBC instances), ``N`` nodes, ``k = N−2f`` data
+shards, ``B`` bytes per shard, ``D`` Merkle proof depth.
+
+Protocol dataflow (all phases batched, nothing data-dependently shaped):
+
+1. *Value* — proposers RS-encode (constant bit-plane matmul → MXU), Merkle
+   commit (batched keccak), and "send" shard i + proof to node i: delivery is
+   the mask ``value_mask[p, i]``.
+2. *Echo* — each node that validated its Value proof re-sends it to all;
+   arrival is ``echo_mask[i, j, p]``.  Receivers verify all N×P proofs in one
+   ``merkle_verify_jax`` sweep and count.
+3. *Ready* — sent on ≥ N−f echoes; one amplification sub-round (f+1 rule);
+   arrival masks ``ready_mask``.
+4. *Decode* — receivers holding ≥ 2f+1 Readys and ≥ k valid echoes pick their
+   first k surviving shard indices, invert the matching encode-matrix rows
+   *on device* (``gf_inv_matrix_jnp`` — the survivor pattern is
+   data-dependent under adversarial drops), reconstruct, re-encode, rebuild
+   the Merkle root and compare — the faulty-proposer (inconsistent codeword)
+   check, exactly as the object-mode ``Broadcast._try_decode``.
+
+Byzantine proposer models:
+- ``codeword_tamper``: XORed into shards *before* the Merkle commit — an
+  inconsistent codeword with valid proofs; caught by the re-encode check.
+- ``value_tamper``: XORed *after* the commit — invalid proofs; caught by
+  per-receiver proof verification.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from hbbft_tpu.ops import gf256
+from hbbft_tpu.ops import rs as rs_mod
+from hbbft_tpu.ops.merkle import merkle_build_jax, merkle_verify_jax
+
+
+class BatchedRbc:
+    """Batched RBC rounds for an (n, f) network.
+
+    All methods are pure array functions, safe under ``jax.jit`` /
+    ``shard_map`` (static shapes, no Python branching on data).
+    """
+
+    def __init__(self, n: int, f: int):
+        self.n = n
+        self.f = f
+        self.coder = rs_mod.for_n_f(n, f)
+        self.k = self.coder.data_shards
+        # constant full-encode bit-matrix (k → n shards) for the re-encode
+        # check; identity-top (systematic) like the object path.
+        self._encode_bits = gf256.gf_matrix_to_bits(self.coder.matrix)
+
+    # ---------------------------------------------------------------- phases
+
+    def propose(self, data, codeword_tamper=None):
+        """Proposer phase: encode + Merkle commit, batched over proposers.
+
+        data: uint8 (P, k, B) → (shards (P, N, B), root (P, 32),
+        proofs (P, N, D, 32), proof_mask (N, D)).
+        """
+        import jax.numpy as jnp
+
+        shards = self.coder.encode_jax(data)  # (P, n, B)
+        if codeword_tamper is not None:
+            shards = shards ^ codeword_tamper
+        root, proofs, pmask = merkle_build_jax(shards)
+        return shards, root, proofs, pmask
+
+    def run(
+        self,
+        data,
+        value_mask=None,
+        echo_mask=None,
+        ready_mask=None,
+        codeword_tamper=None,
+        value_tamper=None,
+    ):
+        """One full batched RBC execution (Value→Echo→Ready→decode).
+
+        data: uint8 (P, k, B).
+        value_mask: bool (P, N) — Value p→i delivered (default all).
+        echo_mask: bool (N, N, P) — Echo i→j for p delivered (default all).
+        ready_mask: bool (N, N, P) — Ready i→j for p delivered (default all).
+        codeword_tamper / value_tamper: uint8 (P, N, B) XOR patterns.
+
+        Returns a dict of arrays:
+        ``delivered`` bool (N, P), ``fault`` bool (N, P) (proposer proven
+        faulty at that receiver), ``data`` uint8 (N, P, k, B) (valid only
+        where delivered), ``root`` (P, 32), ``echo_count`` (N, P),
+        ``ready_count`` (N, P).
+        """
+        shards, root, proofs, pmask = self.propose(data, codeword_tamper)
+        sent = shards if value_tamper is None else shards ^ value_tamper
+        return self.run_from_proposal(
+            sent, root, proofs, pmask, value_mask, echo_mask, ready_mask
+        )
+
+    def run_from_proposal(
+        self,
+        sent,
+        root,
+        proofs,
+        pmask,
+        value_mask=None,
+        echo_mask=None,
+        ready_mask=None,
+        receivers=None,
+    ):
+        """Echo→Ready→decode given (possibly tampered) proposal arrays.
+
+        ``receivers``: optional int array of receiver indices — the decode
+        phase (the per-receiver heavy part) runs only for these; counting
+        phases are global and cheap.  Used by the ``shard_map`` wrapper to
+        place a slice of receivers on each device.  Default: all n.
+        """
+        import jax.numpy as jnp
+
+        n, f, k = self.n, self.f, self.k
+        P = sent.shape[0]
+
+        if value_mask is None:
+            value_mask = jnp.ones((P, n), dtype=bool)
+        if echo_mask is None:
+            echo_mask = jnp.ones((n, n, P), dtype=bool)
+        if ready_mask is None:
+            ready_mask = jnp.ones((n, n, P), dtype=bool)
+        # Self-edges cannot be dropped: object mode handles a node's own
+        # Value/Echo/Ready internally (no network hop), so the diagonal is
+        # forced on to keep mask semantics aligned with the oracle.
+        eye_n = jnp.eye(n, dtype=bool)
+        value_mask = value_mask | (jnp.arange(n)[None, :] == jnp.arange(P)[:, None])
+        echo_mask = echo_mask | eye_n[:, :, None]
+        ready_mask = ready_mask | eye_n[:, :, None]
+
+        # -- Value: node i verifies its own proof (index binding is by
+        # construction: slot i of proposer p's tree) ----------------------
+        idx = jnp.broadcast_to(jnp.arange(n)[None, :], (P, n))
+        vv = merkle_verify_jax(
+            sent,                                  # (P, n, B) leaf values
+            idx,                                   # (P, n)
+            root[:, None, :],                      # broadcast (P, 1, 32)
+            proofs,                                # (P, n, D, 32)
+            pmask[None, :, :],                     # (1, n, D)
+        )  # (P, n) bool
+        vv = vv & value_mask
+
+        # -- Echo: i → all j; per-source validity is vv (tamper is
+        # per-source, so every receiver's verification agrees) -------------
+        # valid_echo[j, i, p] = vv[p, i] & echo_mask[i, j, p]
+        valid_echo = vv.T[None, :, :] & jnp.transpose(echo_mask, (1, 0, 2))
+        echo_count = valid_echo.sum(axis=1)  # (j, P) over sources i
+
+        # -- Ready: send on ≥ n−f echoes; Bracha f+1 amplification to
+        # fixpoint (monotone — matches object-mode run-to-quiescence even
+        # when amplification chains through several hops of the mask) ------
+        import jax
+
+        rmask_t = jnp.transpose(ready_mask, (1, 0, 2))  # (l, j, P)
+        ready_send0 = echo_count >= (n - f)  # (j, P)
+
+        def amplify(_, rs_now):
+            counts = (rs_now[None, :, :] & rmask_t).sum(axis=1)  # (l, P)
+            return rs_now | (counts >= (f + 1))
+
+        ready_send = jax.lax.fori_loop(0, n, amplify, ready_send0)
+        ready_count = (ready_send[None, :, :] & rmask_t).sum(axis=1)  # (l, P)
+
+        can_decode = (ready_count >= (2 * f + 1)) & (echo_count >= k)
+
+        # -- restrict the heavy per-receiver decode to `receivers` ---------
+        if receivers is None:
+            receivers = jnp.arange(n)
+        valid_echo = jnp.take(valid_echo, receivers, axis=0)
+        echo_count = jnp.take(echo_count, receivers, axis=0)
+        ready_count = jnp.take(ready_count, receivers, axis=0)
+        can_decode = jnp.take(can_decode, receivers, axis=0)
+        nl = receivers.shape[0]
+
+        # -- Decode: first-k surviving shard selection (data-dependent) ----
+        sel = jnp.transpose(valid_echo, (0, 2, 1))  # (l, P, i)
+        order = jnp.argsort(~sel, axis=-1, stable=True)  # present-first, asc i
+        use = order[..., :k]  # (l, P, k) survivor shard indices
+        surv_ok = jnp.take_along_axis(sel, use, axis=-1).all(axis=-1)
+
+        # survivor shards: sent[p, use[l,p,t], :] → (l, P, k, B)
+        surv = jnp.take_along_axis(
+            jnp.broadcast_to(sent[None], (nl, *sent.shape)),  # (l, P, n, B)
+            use[..., None],
+            axis=-2,
+        )
+        # decode matrices: encode-matrix rows at the survivor indices
+        enc = jnp.asarray(self.coder.matrix)  # (n, k) constant
+        sub = enc[use]  # (l, P, k, k)
+        dec, inv_ok = gf256.gf_inv_matrix_jnp(sub)
+        dec_bits = gf256.gf_matrix_to_bits_jnp(dec)  # (l, P, k*8, k*8)
+        data_rec = jnp.swapaxes(
+            gf256.gf_apply_bitmatrix(
+                jnp.swapaxes(surv, -1, -2), dec_bits
+            ),
+            -1,
+            -2,
+        )  # (l, P, k, B)
+
+        # -- re-encode + Merkle root check (faulty-proposer detection) -----
+        # Reference semantics (``reed-solomon-erasure``'s reconstruct +
+        # ``Broadcast::compute_output``): present shards are used AS
+        # RECEIVED; only missing ones come from the re-encode.  The root is
+        # rebuilt over that mixed shard set and compared to the agreed one.
+        full = self.coder.encode_jax(data_rec)  # (l, P, n, B)
+        present = sel[..., None]  # (l, P, i, 1)
+        full_obj = jnp.where(present, jnp.broadcast_to(sent[None], full.shape), full)
+        root_chk, _, _ = merkle_build_jax(full_obj)
+        root_ok = jnp.all(root_chk == root[None], axis=-1)  # (l, P)
+        data_rec = full_obj[..., :k, :]  # data rows, received-where-present
+
+        # framing check — object mode's ``_unframe_value`` returns None (→
+        # proposer fault) when the length prefix is inconsistent; mirror it:
+        # the first 4 bytes of the row-major (k·B) stream must encode a
+        # length fitting in the payload.
+        B = sent.shape[-1]
+        flat = data_rec.reshape(*data_rec.shape[:-2], k * B)
+        if k * B >= 4:
+            ln = (
+                flat[..., 0].astype(jnp.uint32) << 24
+                | flat[..., 1].astype(jnp.uint32) << 16
+                | flat[..., 2].astype(jnp.uint32) << 8
+                | flat[..., 3].astype(jnp.uint32)
+            )
+            frame_ok = ln <= jnp.uint32(k * B - 4)  # no +4: uint32 overflow
+        else:
+            frame_ok = jnp.zeros(flat.shape[:-1], dtype=bool)
+
+        ok = can_decode & surv_ok & inv_ok
+        delivered = ok & root_ok & frame_ok
+        fault = ok & ~(root_ok & frame_ok)
+        return {
+            "delivered": delivered,
+            "fault": fault,
+            "data": data_rec,
+            "root": root,
+            "echo_count": echo_count,
+            "ready_count": ready_count,
+        }
+
+
+# -- host-side helpers for tests / object-mode cross-checks -----------------
+
+
+def frame_values(values, k: int) -> np.ndarray:
+    """Frame a list of P byte-strings like the object-mode proposer does
+    (4-byte length prefix, zero-padded) at one common shard length, so the
+    row-major byte stream stays contiguous: (P, k, B)."""
+    shard_len = max(1, max(-(-(4 + len(v)) // k) for v in values))
+    out = np.zeros((len(values), k, shard_len), dtype=np.uint8)
+    for i, v in enumerate(values):
+        stream = len(v).to_bytes(4, "big") + v
+        stream = stream.ljust(k * shard_len, b"\0")
+        out[i] = np.frombuffer(stream, dtype=np.uint8).reshape(k, shard_len)
+    return out
+
+
+def unframe_value(data_row: np.ndarray) -> Optional[bytes]:
+    """Inverse of :func:`frame_values` for one (k, B) reconstruction."""
+    from hbbft_tpu.protocols.broadcast import _unframe_value
+
+    return _unframe_value(np.asarray(data_row, dtype=np.uint8).tobytes())
