@@ -18,12 +18,15 @@ stack of asynchronous BFT consensus state machines —
   membership via on-line DKG,
 - ``protocols.queueing_honey_badger`` — transaction queueing.
 
-The hot per-epoch math (RS encode/reconstruct, keccak, BLS/TPKE share ops)
-lives in ``ops/`` as batched jnp/Pallas kernels that vmap over
-(node × instance × epoch); ``parallel/`` holds the dense-array bulk-synchronous
-simulator that drives all N nodes through one device step per communication
-round under ``shard_map``; ``sim/`` holds the object-mode deterministic
-``VirtualNet`` harness with adversaries (reference: ``tests/net/``).
+The hot per-epoch math (GF(2^8) Reed–Solomon, keccak/Merkle) lives in
+``ops/`` as batched jnp kernels over arbitrary leading axes
+(node × instance × epoch); ``parallel/`` holds the dense-array
+bulk-synchronous simulator — currently the full RBC round over
+(proposer × receiver), single-device or ``shard_map``-sharded over a mesh —
+cross-checked against object mode; ``sim/`` holds the object-mode
+deterministic ``VirtualNet`` harness with adversaries (reference:
+``tests/net/``).  BLS/TPKE is host-side (``crypto/``) pending the on-device
+limbed-field backend.
 
 The reference is sans-I/O: every algorithm consumes inputs/messages and
 returns a ``Step``; the caller owns the event loop.  We keep that contract

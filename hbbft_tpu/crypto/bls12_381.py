@@ -17,8 +17,8 @@ that curve layer.  Design notes:
   canonical pairing, which preserves bilinearity and non-degeneracy — all
   callers only compare pairing products).
 - ``pairing_check([(P,Q),...])`` shares one Miller product and one final
-  exponentiation across all pairs — the batch-verification trick that the
-  batched TPU verifier also uses.
+  exponentiation across all pairs — the batch-verification trick a future
+  on-device verifier can reuse.
 
 Representation conventions: Fp = int; Fp2 = (int, int); Fp12 = 6-tuple of
 Fp2; curve points are Jacobian triples; G1 over Fp, G2 over Fp2.  Infinity is

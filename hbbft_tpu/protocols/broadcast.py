@@ -107,7 +107,7 @@ class Broadcast(ConsensusProtocol):
 
         Reference: ``Broadcast::send_shards`` (HOT: GF(2^8) matmul + keccak;
         the batched simulator replaces this whole path with
-        ``parallel.batched_rbc``).
+        ``parallel.rbc.BatchedRbc.propose``).
         """
         self.value_received = True
         data = _frame_value(value, self.data_shard_num)

@@ -228,7 +228,7 @@ class HoneyBadger(ConsensusProtocol):
         """Encrypt (per schedule) and propose into the current epoch's ACS.
 
         Reference: ``HoneyBadger::propose`` (HOT: TPKE encrypt —
-        G1/G2 scalar muls; batched on TPU in ``parallel.batched_hb``).
+        G1/G2 scalar muls).
         """
         if self.has_input.get(self.epoch):
             return Step()
