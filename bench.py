@@ -308,12 +308,69 @@ def bench_rbc_round(n: int = 64, f: int = 21, msg_len: int = 512):
     }
 
 
+def bench_coin256(n: int = 256, f: int = 85):
+    """BASELINE config 3: common-coin share verification at N=256 —
+    randomized-linear-combination batch verify (device G1+G2 ladders + one
+    host pairing check) vs per-share host pairing verification (sampled)."""
+    import random
+
+    from hbbft_tpu.crypto.batch import batch_verify_sig_shares
+    from hbbft_tpu.crypto.tc import SecretKeySet
+
+    rng = random.Random(99)
+    print(f"# coin256: generating {n} key/signature shares…", file=sys.stderr)
+    sks = SecretKeySet.random(f, rng)
+    pks = sks.public_keys()
+    msg = b"coin-epoch-42"
+    pairs = [
+        (pks.public_key_share(i), sks.secret_key_share(i).sign(msg))
+        for i in range(n)
+    ]
+
+    # warm (compiles the two ladders)
+    assert batch_verify_sig_shares(pairs, msg, rng) is True
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ok = batch_verify_sig_shares(pairs, msg, rng)
+        times.append(time.perf_counter() - t0)
+        assert ok
+    t_dev = float(np.median(times))
+
+    # host baseline: per-share pairing verification, sampled
+    sample = 4
+
+    def host_once():
+        for pk, s in pairs[:sample]:
+            assert pk.verify(s, msg)
+
+    t_host = _timeit(host_once, warmup=1, iters=2, min_time=0.0) / sample * n
+
+    return {
+        "metric": "coin256_share_batch_verify",
+        "value": round(n / t_dev, 2),
+        "unit": "shares/s",
+        "vs_baseline": round(t_host / t_dev, 2),
+        "t_device_s": round(t_dev, 6),
+        "t_host_s": round(t_host, 6),
+        "shape": f"N={n} f={f}",
+    }
+
+
 CONFIGS = {
     "rbc-round": bench_rbc_round,
     "rbc64": bench_rbc64,
     "rbc64-reconstruct": bench_rbc64_reconstruct,
     "sha3": bench_sha3,
+    "coin256": bench_coin256,
 }
+
+# coin256 is excluded from "all": the device BLS ladder is correct but its
+# current XLA lowering is dispatch-bound (~4 min/verify at N=256 — slower
+# than the host path) and its first compile is ~8 min.  Run it explicitly
+# with --config coin256; making it win is open optimization work (stacked
+# formula batching / a Pallas field kernel).
+_DEFAULT_SET = [k for k in CONFIGS if k != "coin256"]
 
 
 def main(argv=None):
@@ -326,7 +383,7 @@ def main(argv=None):
     device = jax.devices()[0]
     print(f"# device: {device.platform} {device.device_kind}", file=sys.stderr)
 
-    names = list(CONFIGS) if args.config == "all" else [args.config]
+    names = _DEFAULT_SET if args.config == "all" else [args.config]
     results = []
     for name in names:
         r = CONFIGS[name]()

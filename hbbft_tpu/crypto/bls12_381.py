@@ -391,6 +391,9 @@ def g1_neg(pt):
 
 def g1_mul(pt, k: int):
     k %= R
+    nat = _native()
+    if nat is not None and pt is not None:
+        return _g1_from_bytes_trusted(nat.bls_g1_mul(g1_to_bytes(pt), k))
     result = None
     add = pt
     while k:
@@ -455,6 +458,9 @@ def g2_neg(pt):
 def g2_mul(pt, k: int, mod_r: bool = True):
     if mod_r:
         k %= R
+        nat = _native()
+        if nat is not None and pt is not None:
+            return _g2_from_bytes_trusted(nat.bls_g2_mul(g2_to_bytes(pt), k))
     result = None
     add = pt
     while k:
@@ -595,13 +601,69 @@ def pairing(p1, q2):
     return _final_exponentiation(_miller_loop([(p1, q2)]))
 
 
+# --------------------------------------------------------------------------
+# Native (C++) fast path — byte-parity-proven oracle for the hot operations
+# --------------------------------------------------------------------------
+# The C++ oracle (native/bls381.cpp) implements the same algorithms with
+# constants generated from this module; tests/test_native_bls.py asserts
+# byte-exact parity.  The pure-Python path remains the ground truth and is
+# forced with HBBFT_PURE_PYTHON=1 (parity/unit tests do this).
+
+_NATIVE = None
+_NATIVE_TRIED = False
+
+
+def _native():
+    global _NATIVE, _NATIVE_TRIED
+    if not _NATIVE_TRIED:
+        _NATIVE_TRIED = True
+        import os
+
+        if not os.environ.get("HBBFT_PURE_PYTHON"):
+            try:
+                from hbbft_tpu.native import get_oracle
+
+                _NATIVE = get_oracle()
+            except Exception as exc:
+                import warnings
+
+                warnings.warn(
+                    "native BLS oracle unavailable — falling back to the "
+                    f"(much slower) pure-Python path: {exc!r}"
+                )
+                _NATIVE = None
+    return _NATIVE
+
+
+class pure_python:
+    """Context manager forcing the pure-Python path (parity tests use this
+    so both sides of a native-vs-host assertion are independent)."""
+
+    def __enter__(self):
+        global _NATIVE, _NATIVE_TRIED
+        self._saved = (_NATIVE, _NATIVE_TRIED)
+        _NATIVE, _NATIVE_TRIED = None, True
+        return self
+
+    def __exit__(self, *exc):
+        global _NATIVE, _NATIVE_TRIED
+        _NATIVE, _NATIVE_TRIED = self._saved
+        return False
+
+
 def pairing_check(pairs: Sequence[Tuple[object, object]]) -> bool:
     """True iff Π e(P_i, Q_i) == 1 — one shared Miller product + final exp.
 
     This is how all signature/share verifications are phrased:
     ``e(g1, sig) == e(pk, H)`` ⟺ ``pairing_check([(−g1, sig), (pk, H)])``.
     """
-    f = _miller_loop([(p, q) for (p, q) in pairs if p is not None and q is not None])
+    live = [(p, q) for (p, q) in pairs if p is not None and q is not None]
+    nat = _native()
+    if nat is not None:
+        return nat.bls_pairing_check(
+            [(g1_to_bytes(p), g2_to_bytes(q)) for p, q in live]
+        )
+    f = _miller_loop(live)
     return _final_exponentiation(f) == FP12_ONE
 
 
@@ -628,6 +690,9 @@ def hash_g2(data: bytes):
     role; bit-compatibility with it is not required — only internal
     consistency, as with all our crypto.)
     """
+    nat = _native()
+    if nat is not None:
+        return _g2_from_bytes_trusted(nat.bls_hash_g2(bytes(data)))
     ctr = 0
     while True:
         x = _hash_fp2(data, ctr)
@@ -649,6 +714,9 @@ def hash_g2(data: bytes):
 
 def hash_g1(data: bytes):
     """Hash to G1 (same approach; used for plain per-node signatures)."""
+    nat = _native()
+    if nat is not None:
+        return _g1_from_bytes_trusted(nat.bls_hash_g1(bytes(data)))
     ctr = 0
     while True:
         h0 = hashlib.sha3_256(b"HBBFT-H1G-0" + ctr.to_bytes(4, "big") + data).digest()
@@ -691,6 +759,25 @@ def g1_to_bytes(pt) -> bytes:
         return b"\x40" + bytes(96)  # infinity flag
     x, y, _ = g1_affine(pt)
     return b"\x00" + x.to_bytes(48, "big") + y.to_bytes(48, "big")
+
+
+def _g1_from_bytes_trusted(data: bytes):
+    """Deserialize WITHOUT curve/subgroup checks — only for values produced
+    by the byte-parity-proven native oracle."""
+    if data[0] == 0x40:
+        return None
+    return (
+        int.from_bytes(data[1:49], "big"),
+        int.from_bytes(data[49:97], "big"),
+        1,
+    )
+
+
+def _g2_from_bytes_trusted(data: bytes):
+    if data[0] == 0x40:
+        return None
+    vals = [int.from_bytes(data[1 + i * 48 : 49 + i * 48], "big") for i in range(4)]
+    return ((vals[0], vals[1]), (vals[2], vals[3]), FP2_ONE)
 
 
 def g1_from_bytes(data: bytes):

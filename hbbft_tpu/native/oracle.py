@@ -29,7 +29,9 @@ class NativeOracle:
             os.path.getmtime(_LIB)
             < max(
                 os.path.getmtime(os.path.join(_DIR, f))
-                for f in ("gf256.cpp", "keccak.cpp")
+                for f in ("gf256.cpp", "keccak.cpp", "bls381.cpp",
+                          "gen_bls_constants.py",
+                          os.path.join("..", "crypto", "bls12_381.py"))
             )
         ):
             _build()
@@ -55,6 +57,28 @@ class NativeOracle:
         lib.hbbft_sha3_256_batch.argtypes = [
             u8p, ctypes.c_int64, ctypes.c_int64, u8p,
         ]
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        i = ctypes.c_int
+        i64 = ctypes.c_int64
+        for name, args, res in [
+            ("bls_g1_add", [u8p, u8p, u8p], i),
+            ("bls_g1_mul", [u8p, u8p, u8p], i),
+            ("bls_g2_add", [u8p, u8p, u8p], i),
+            ("bls_g2_mul", [u8p, u8p, u8p], i),
+            ("bls_hash_g1", [u8p, i64, u8p], None),
+            ("bls_hash_g2", [u8p, i64, u8p], None),
+            ("bls_pairing_check", [u8p, u8p, i], i),
+            ("bls_sign", [u8p, i64, u8p, u8p], None),
+            ("bls_verify", [u8p, u8p, i64, u8p], i),
+            ("bls_combine_g2", [u32p, u8p, i, u8p], i),
+            ("bls_combine_g1", [u32p, u8p, i, u8p], i),
+            ("bls_tpke_encrypt", [u8p, u8p, i64, u8p, u8p, u8p, u8p], i),
+            ("bls_tpke_verify", [u8p, u8p, i64, u8p], i),
+            ("bls_tpke_combine", [u32p, u8p, i, u8p, i64, u8p], i),
+        ]:
+            fn = getattr(lib, name)
+            fn.argtypes = args
+            fn.restype = res
         self._lib = lib
 
     @staticmethod
@@ -149,6 +173,130 @@ class NativeOracle:
         out = np.empty((n, 32), dtype=np.uint8)
         self._lib.hbbft_sha3_256_batch(self._p(msgs), n, L, self._p(out))
         return out
+
+
+    # -- BLS12-381 full scheme (bls381.cpp) ---------------------------------
+    # All points use the host serialization (G1: 97 bytes, G2: 193 bytes);
+    # scalars are 32-byte big-endian.
+
+    @staticmethod
+    def _buf(n: int) -> np.ndarray:
+        return np.zeros(n, dtype=np.uint8)
+
+    @staticmethod
+    def _arr(b: bytes) -> np.ndarray:
+        return np.frombuffer(bytes(b), dtype=np.uint8)
+
+    def bls_g1_add(self, a: bytes, b: bytes) -> bytes:
+        out = self._buf(97)
+        assert self._lib.bls_g1_add(self._p(self._arr(a)), self._p(self._arr(b)), self._p(out)) == 0
+        return out.tobytes()
+
+    def bls_g1_mul(self, a: bytes, k: int) -> bytes:
+        out = self._buf(97)
+        kb = self._arr(k.to_bytes(32, "big"))
+        assert self._lib.bls_g1_mul(self._p(self._arr(a)), self._p(kb), self._p(out)) == 0
+        return out.tobytes()
+
+    def bls_g2_add(self, a: bytes, b: bytes) -> bytes:
+        out = self._buf(193)
+        assert self._lib.bls_g2_add(self._p(self._arr(a)), self._p(self._arr(b)), self._p(out)) == 0
+        return out.tobytes()
+
+    def bls_g2_mul(self, a: bytes, k: int) -> bytes:
+        out = self._buf(193)
+        kb = self._arr(k.to_bytes(32, "big"))
+        assert self._lib.bls_g2_mul(self._p(self._arr(a)), self._p(kb), self._p(out)) == 0
+        return out.tobytes()
+
+    def bls_hash_g1(self, msg: bytes) -> bytes:
+        out = self._buf(97)
+        self._lib.bls_hash_g1(self._p(self._arr(msg or b"\0")), len(msg), self._p(out))
+        return out.tobytes()
+
+    def bls_hash_g2(self, msg: bytes) -> bytes:
+        out = self._buf(193)
+        self._lib.bls_hash_g2(self._p(self._arr(msg or b"\0")), len(msg), self._p(out))
+        return out.tobytes()
+
+    def bls_pairing_check(self, pairs) -> bool:
+        n = len(pairs)
+        g1s = np.concatenate([self._arr(p) for p, _ in pairs]) if n else self._buf(97)
+        g2s = np.concatenate([self._arr(q) for _, q in pairs]) if n else self._buf(193)
+        rc = self._lib.bls_pairing_check(self._p(g1s), self._p(g2s), n)
+        assert rc >= 0
+        return bool(rc)
+
+    def bls_sign(self, msg: bytes, sk: int) -> bytes:
+        out = self._buf(193)
+        self._lib.bls_sign(
+            self._p(self._arr(msg or b"\0")), len(msg),
+            self._p(self._arr(sk.to_bytes(32, "big"))), self._p(out),
+        )
+        return out.tobytes()
+
+    def bls_verify(self, pk: bytes, msg: bytes, sig: bytes) -> bool:
+        rc = self._lib.bls_verify(
+            self._p(self._arr(pk)), self._p(self._arr(msg or b"\0")),
+            len(msg), self._p(self._arr(sig)),
+        )
+        assert rc >= 0
+        return bool(rc)
+
+    def _idx(self, indices):
+        import ctypes
+
+        arr = np.asarray(indices, dtype=np.uint32)
+        return arr, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+
+    def bls_combine_g2(self, shares: dict) -> bytes:
+        items = sorted(shares.items())
+        keep, idxp = self._idx([i for i, _ in items])
+        buf = np.concatenate([self._arr(s) for _, s in items])
+        out = self._buf(193)
+        assert self._lib.bls_combine_g2(idxp, self._p(buf), len(items), self._p(out)) == 0
+        return out.tobytes()
+
+    def bls_combine_g1(self, shares: dict) -> bytes:
+        items = sorted(shares.items())
+        keep, idxp = self._idx([i for i, _ in items])
+        buf = np.concatenate([self._arr(s) for _, s in items])
+        out = self._buf(97)
+        assert self._lib.bls_combine_g1(idxp, self._p(buf), len(items), self._p(out)) == 0
+        return out.tobytes()
+
+    def bls_tpke_encrypt(self, pk: bytes, msg: bytes, r: int):
+        u = self._buf(97)
+        v = self._buf(max(len(msg), 1))
+        w = self._buf(193)
+        assert self._lib.bls_tpke_encrypt(
+            self._p(self._arr(pk)), self._p(self._arr(msg or b"\0")),
+            len(msg), self._p(self._arr(r.to_bytes(32, "big"))),
+            self._p(u), self._p(v), self._p(w),
+        ) == 0
+        return u.tobytes(), v.tobytes()[: len(msg)], w.tobytes()
+
+    def bls_tpke_verify(self, u: bytes, v: bytes, w: bytes) -> bool:
+        rc = self._lib.bls_tpke_verify(
+            self._p(self._arr(u)), self._p(self._arr(v or b"\0")),
+            len(v), self._p(self._arr(w)),
+        )
+        assert rc >= 0
+        return bool(rc)
+
+    def bls_tpke_decrypt_share(self, u: bytes, sk: int) -> bytes:
+        return self.bls_g1_mul(u, sk)
+
+    def bls_tpke_combine(self, shares: dict, v: bytes) -> bytes:
+        items = sorted(shares.items())
+        keep, idxp = self._idx([i for i, _ in items])
+        buf = np.concatenate([self._arr(s) for _, s in items])
+        out = self._buf(max(len(v), 1))
+        assert self._lib.bls_tpke_combine(
+            idxp, self._p(buf), len(items),
+            self._p(self._arr(v or b"\0")), len(v), self._p(out),
+        ) == 0
+        return out.tobytes()[: len(v)]
 
 
 def get_oracle() -> NativeOracle:

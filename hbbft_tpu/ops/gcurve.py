@@ -1,0 +1,366 @@
+"""Batched G1/G2 Jacobian point arithmetic on TPU.
+
+Mirrors the host oracle's generic Jacobian formulas
+(``crypto/bls12_381.py :: _jac_double / _jac_add`` — dbl-2009-l and
+add-2007-bl) over the limbed device field (:mod:`hbbft_tpu.ops.fp381`),
+with a *complete* branchless addition: the P==Q case routes through the
+doubling result and P==−Q falls out naturally (the add formula's Z3 = 2·Z1
+Z2·H is zero when H = 0), all chosen by masks — no data-dependent Python
+control flow, so everything jits, vmaps, and ladders under ``lax.fori_loop``.
+
+Points are (X, Y, Z) limb pytrees with **Z = 0 encoding infinity** (the host
+uses ``None``).  A batch of points is just leading axes on every limb array.
+
+The scalar ladder is fixed-length (255 = |r| bits, MSB-first, select-by-bit)
+— constant shape, constant time.  ``msm`` tree-reduces a batch of ladders:
+the multi-scalar multiplication at the heart of randomized-linear-combination
+share verification (SURVEY §7.2c: the common-coin hot loop).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hbbft_tpu.crypto.bls12_381 import R
+from hbbft_tpu.ops import fp381 as F
+
+R_BITS = 255
+
+
+# ---------------------------------------------------------------------------
+# field-op bundles (G1 over Fp, G2 over Fp2) so the formulas are written once
+# ---------------------------------------------------------------------------
+
+
+class _FpOps:
+    add = staticmethod(F.fp_add)
+    sub = staticmethod(F.fp_sub)
+    mul = staticmethod(F.fp_mul)
+    sqr = staticmethod(F.fp_sqr)
+    neg = staticmethod(F.fp_neg)
+    is_zero = staticmethod(F.fp_is_zero)
+    select = staticmethod(F.fp_select)
+
+
+class _Fp2Ops:
+    add = staticmethod(F.fp2_add)
+    sub = staticmethod(F.fp2_sub)
+    mul = staticmethod(F.fp2_mul)
+    sqr = staticmethod(F.fp2_sqr)
+    neg = staticmethod(F.fp2_neg)
+    is_zero = staticmethod(F.fp2_is_zero)
+    select = staticmethod(F.fp2_select)
+
+
+class _LazyFpOps:
+    """Non-canonical fast field (see fp381 lazy section for the soundness
+    conditions — ladders must use scalars < 2^128)."""
+
+    add = staticmethod(F.fp_add_lazy)
+    sub = staticmethod(F.fp_sub_lazy)
+    mul = staticmethod(F.fp_mul_lazy)
+    sqr = staticmethod(lambda a: F.fp_mul_lazy(a, a))
+    neg = staticmethod(F.fp_neg_lazy)
+    is_zero = staticmethod(F.fp_is_zero_digits)
+    select = staticmethod(F.fp_select)
+
+
+class _LazyFp2Ops:
+    add = staticmethod(F.fp2_add_lazy)
+    sub = staticmethod(F.fp2_sub_lazy)
+    mul = staticmethod(F.fp2_mul_lazy)
+    sqr = staticmethod(F.fp2_sqr_lazy)
+    neg = staticmethod(F.fp2_neg_lazy)
+    is_zero = staticmethod(F.fp2_is_zero_digits)
+    select = staticmethod(F.fp2_select)
+
+
+def _dbl_small(o, a, times: int):
+    """a·2^times via repeated additions (host oracle's ``scal`` uses small
+    integer factors 2 and 8 only)."""
+    for _ in range(times):
+        a = o.add(a, a)
+    return a
+
+
+# ---------------------------------------------------------------------------
+# point formulas (generic over the ops bundle)
+# ---------------------------------------------------------------------------
+
+
+def point_double(o, pt):
+    x, y, z = pt
+    a = o.sqr(x)
+    b = o.sqr(y)
+    c = o.sqr(b)
+    d = o.sub(o.sqr(o.add(x, b)), o.add(a, c))
+    d = o.add(d, d)
+    e = o.add(o.add(a, a), a)
+    f = o.sqr(e)
+    x3 = o.sub(f, o.add(d, d))
+    y3 = o.sub(o.mul(e, o.sub(d, x3)), _dbl_small(o, c, 3))
+    z3 = o.mul(o.add(y, y), z)
+    return (x3, y3, z3)
+
+
+def point_add_raw(o, p1, p2):
+    """add-2007-bl only — valid for FINITE operands with distinct x.
+
+    The lazy ladder uses this with explicit infinity flags (its scalar
+    regime rules out the P==±Q cases; see :func:`scalar_mul_lazy`)."""
+    x1, y1, z1 = p1
+    x2, y2, z2 = p2
+    z1z1 = o.sqr(z1)
+    z2z2 = o.sqr(z2)
+    u1 = o.mul(x1, z2z2)
+    u2 = o.mul(x2, z1z1)
+    s1 = o.mul(o.mul(y1, z2), z2z2)
+    s2 = o.mul(o.mul(y2, z1), z1z1)
+    h = o.sub(u2, u1)
+    r = o.sub(s2, s1)
+    i = o.sqr(o.add(h, h))
+    j = o.mul(h, i)
+    r2 = o.add(r, r)
+    v = o.mul(u1, i)
+    x3 = o.sub(o.sub(o.sqr(r2), j), o.add(v, v))
+    y3 = o.sub(o.mul(r2, o.sub(v, x3)), _dbl_small(o, o.mul(s1, j), 1))
+    z3 = o.mul(_dbl_small(o, o.mul(z1, z2), 1), h)
+    return (x3, y3, z3)
+
+
+def point_add(o, p1, p2):
+    """Complete addition: handles inf operands, P==Q, and P==−Q by masks."""
+    x1, y1, z1 = p1
+    x2, y2, z2 = p2
+    inf1 = o.is_zero(z1)
+    inf2 = o.is_zero(z2)
+
+    z1z1 = o.sqr(z1)
+    z2z2 = o.sqr(z2)
+    u1 = o.mul(x1, z2z2)
+    u2 = o.mul(x2, z1z1)
+    s1 = o.mul(o.mul(y1, z2), z2z2)
+    s2 = o.mul(o.mul(y2, z1), z1z1)
+    h = o.sub(u2, u1)
+    r = o.sub(s2, s1)
+    same_x = o.is_zero(h)
+    same_y = o.is_zero(r)
+    is_dbl = same_x & same_y & ~inf1 & ~inf2
+
+    i = o.sqr(o.add(h, h))
+    j = o.mul(h, i)
+    r2 = o.add(r, r)
+    v = o.mul(u1, i)
+    x3 = o.sub(o.sub(o.sqr(r2), j), o.add(v, v))
+    y3 = o.sub(o.mul(r2, o.sub(v, x3)), _dbl_small(o, o.mul(s1, j), 1))
+    z3 = o.mul(_dbl_small(o, o.mul(z1, z2), 1), h)
+    # same_x & ~same_y (P = −Q): z3 = …·h = 0 already encodes infinity.
+
+    dx, dy, dz = point_double(o, p1)
+    x3 = o.select(is_dbl, dx, x3)
+    y3 = o.select(is_dbl, dy, y3)
+    z3 = o.select(is_dbl, dz, z3)
+    # inf operands
+    x3 = o.select(inf2, x1, o.select(inf1, x2, x3))
+    y3 = o.select(inf2, y1, o.select(inf1, y2, y3))
+    z3 = o.select(inf2, z1, o.select(inf1, z2, z3))
+    return (x3, y3, z3)
+
+
+def point_select(o, mask, p, q):
+    return (
+        o.select(mask, p[0], q[0]),
+        o.select(mask, p[1], q[1]),
+        o.select(mask, p[2], q[2]),
+    )
+
+
+def scalar_mul(o, pt, bits):
+    """Fixed-length MSB-first double-and-add ladder, batched.
+
+    pt: (X, Y, Z) with batch leading axes; bits: int32 (..., nbits)
+    little-endian bit order (bit i = 2^i coefficient).  The ladder length is
+    bits.shape[-1]: pass 255 for full-range scalars (canonical ops) or 128
+    for the lazy-ops randomizer path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    nbits = bits.shape[-1]
+
+    def zeros_like_coord(c):
+        if isinstance(c, tuple):
+            return tuple(jnp.zeros_like(x) for x in c)
+        return jnp.zeros_like(c)
+
+    acc = tuple(zeros_like_coord(c) for c in pt)  # infinity (Z = 0)
+
+    def body(i, acc):
+        acc = point_double(o, acc)
+        with_add = point_add(o, acc, pt)
+        bit = jax.lax.dynamic_index_in_dim(
+            bits, nbits - 1 - i, axis=-1, keepdims=False
+        ).astype(bool)
+        return point_select(o, bit, with_add, acc)
+
+    return jax.lax.fori_loop(0, nbits, body, acc)
+
+
+def scalar_mul_lazy(o, pt, bits, base_inf):
+    """Ladder for the LAZY field ops, with infinity as an explicit flag.
+
+    The lazy field does not preserve digit-zero through subtractions (Fp2
+    Karatsuba routes products of zero through them), so Z-digit-zero cannot
+    encode infinity; instead an ``inf`` bool mask rides along and the raw
+    add formula is used.  Soundness requires scalars < 2^128 (rules out the
+    P == ±Q ladder collisions — a collision needs a bit-prefix m with
+    2m ≡ ±1 (mod r), i.e. m ≥ (r−1)/2 ≥ 2^253).
+
+    pt: (X, Y, Z); bits (..., nbits) little-endian; base_inf bool (...,).
+    Returns ((X, Y, Z), inf_mask).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    nbits = bits.shape[-1]
+
+    def zeros_like_coord(c):
+        if isinstance(c, tuple):
+            return tuple(jnp.zeros_like(x) for x in c)
+        return jnp.zeros_like(c)
+
+    acc0 = tuple(zeros_like_coord(c) for c in pt)
+    inf0 = jnp.ones(base_inf.shape, dtype=bool)
+
+    def body(i, carry):
+        acc, inf = carry
+        acc = point_double(o, acc)  # double keeps finiteness (odd order)
+        added = point_add_raw(o, acc, pt)
+        # if acc is ∞: acc + base = base; if base is ∞: stays acc
+        res = point_select(o, inf, pt, point_select(o, base_inf, acc, added))
+        res_inf = inf & base_inf
+        bit = jax.lax.dynamic_index_in_dim(
+            bits, nbits - 1 - i, axis=-1, keepdims=False
+        ).astype(bool)
+        acc = point_select(o, bit, res, acc)
+        inf = jnp.where(bit, res_inf, inf)
+        return acc, inf
+
+    return jax.lax.fori_loop(0, nbits, body, (acc0, inf0))
+
+
+def msm(o, pt, bits):
+    """Σ_b bits[b]·pt[b] — batched ladders, then a tree of point_adds where
+    each level HALVES the batch by adding the two halves.
+
+    The tree is folded on fixed pairings so the whole reduction is
+    log₂(B) batched adds; callers that are compile-time-sensitive (CPU
+    tests) can instead fetch the ladder results and accumulate on the host
+    (see ``crypto/batch.py``), since the ladders dominate the math.
+    """
+    import jax.numpy as jnp
+
+    def take(c, sl):
+        if isinstance(c, tuple):
+            return tuple(x[sl] for x in c)
+        return c[sl]
+
+    def pad_inf(c, n):
+        if isinstance(c, tuple):
+            return tuple(
+                jnp.concatenate([x, jnp.zeros((n, *x.shape[1:]), x.dtype)])
+                for x in c
+            )
+        return jnp.concatenate([c, jnp.zeros((n, *c.shape[1:]), c.dtype)])
+
+    pts = scalar_mul(o, pt, bits)  # (B, …) points
+    B = pts[0][0].shape[0] if isinstance(pts[0], tuple) else pts[0].shape[0]
+    size = 1
+    while size < B:
+        size *= 2
+    if size != B:
+        pts = tuple(pad_inf(c, size - B) for c in pts)
+    while size > 1:
+        half = size // 2
+        lo = tuple(take(c, slice(0, half)) for c in pts)
+        hi = tuple(take(c, slice(half, size)) for c in pts)
+        pts = point_add(o, lo, hi)
+        size = half
+    return tuple(take(c, 0) for c in pts)
+
+
+# ---------------------------------------------------------------------------
+# host conversions
+# ---------------------------------------------------------------------------
+
+
+def scalars_to_bits(scalars: Sequence[int], nbits: int = R_BITS) -> np.ndarray:
+    """ints (mod r) → (B, nbits) int32 little-endian bits."""
+    out = np.zeros((len(scalars), nbits), dtype=np.int32)
+    for i, s in enumerate(scalars):
+        s %= R
+        assert s < (1 << nbits), "scalar exceeds ladder width"
+        for b in range(nbits):
+            out[i, b] = (s >> b) & 1
+    return out
+
+
+def g1_to_device(points: Sequence[Optional[tuple]]) -> Tuple:
+    """Host Jacobian G1 points (or None) → stacked device limb arrays."""
+    xs, ys, zs = [], [], []
+    for p in points:
+        if p is None:
+            xs.append(np.zeros(F.NL, np.int32))
+            ys.append(np.zeros(F.NL, np.int32))
+            zs.append(np.zeros(F.NL, np.int32))
+        else:
+            xs.append(F.int_to_limbs(p[0] % F.P))
+            ys.append(F.int_to_limbs(p[1] % F.P))
+            zs.append(F.int_to_limbs(p[2] % F.P))
+    return (np.stack(xs), np.stack(ys), np.stack(zs))
+
+
+def g1_from_device(pt) -> Optional[tuple]:
+    """Device → host point; canonicalizes on host (lazy-path values are
+    arbitrary residues)."""
+    x, y, z = (np.asarray(c) for c in pt)
+    zi = F.limbs_to_int(z) % F.P
+    if zi == 0:
+        return None
+    return (F.limbs_to_int(x) % F.P, F.limbs_to_int(y) % F.P, zi)
+
+
+def g2_to_device(points: Sequence[Optional[tuple]]) -> Tuple:
+    """Host Jacobian G2 points (Fp2 coords) → device ((re,im) limb pairs)."""
+    coords = ([], []), ([], []), ([], [])
+    for p in points:
+        if p is None:
+            p = ((0, 0), (0, 0), (0, 0))
+        for ci, c in enumerate(p):
+            coords[ci][0].append(F.int_to_limbs(c[0] % F.P))
+            coords[ci][1].append(F.int_to_limbs(c[1] % F.P))
+    return tuple(
+        (np.stack(re), np.stack(im)) for (re, im) in coords
+    )
+
+
+def g2_from_device(pt) -> Optional[tuple]:
+    (xr, xi), (yr, yi), (zr, zi) = (
+        (np.asarray(c[0]), np.asarray(c[1])) for c in pt
+    )
+    z = (F.limbs_to_int(zr) % F.P, F.limbs_to_int(zi) % F.P)
+    if z == (0, 0):
+        return None
+    return (
+        (F.limbs_to_int(xr) % F.P, F.limbs_to_int(xi) % F.P),
+        (F.limbs_to_int(yr) % F.P, F.limbs_to_int(yi) % F.P),
+        z,
+    )
+
+
+FP_OPS = _FpOps()
+FP2_OPS = _Fp2Ops()
+LAZY_FP_OPS = _LazyFpOps()
+LAZY_FP2_OPS = _LazyFp2Ops()

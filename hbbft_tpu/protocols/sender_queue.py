@@ -45,17 +45,26 @@ class AlgoMessage:
     msg: Any
 
 
-def _message_key(msg: Any) -> Optional[EpochKey]:
-    """The (era, epoch) a message belongs to, or None if always deliverable."""
+def _message_key(msg: Any) -> EpochKey:
+    """The (era, epoch) a message belongs to.
+
+    Every message type the wrapped algorithms emit is enumerated; an unknown
+    type is a bug in the wrapper, not an always-deliverable message, so it
+    raises instead of silently bypassing the buffering discipline."""
     if isinstance(msg, (SubsetWrap, DecryptionShareWrap)):
         return (0, msg.epoch)
     if isinstance(msg, HbWrap):
         inner = msg.msg
-        ep = getattr(inner, "epoch", 0)
-        return (msg.era, ep)
+        if isinstance(inner, (SubsetWrap, DecryptionShareWrap)):
+            return (msg.era, inner.epoch)
+        raise TypeError(
+            f"SenderQueue: unknown HbWrap inner message {type(inner).__name__}"
+        )
     if isinstance(msg, KeyGenWrap):
         return (msg.era, 0)
-    return None
+    raise TypeError(
+        f"SenderQueue: no epoch key rule for {type(msg).__name__}"
+    )
 
 
 def _algo_key(algo: Any) -> EpochKey:

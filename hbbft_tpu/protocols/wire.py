@@ -117,3 +117,211 @@ def read_commitment_bivar(r: Reader) -> tc.BivarCommitment:
         for _ in range(degree + 1)
     ]
     return tc.BivarCommitment(degree, pts)
+
+
+# ===========================================================================
+# Full protocol-message wire format
+# ===========================================================================
+#
+# The reference serializes EVERY message with serde/bincode; this is the
+# equivalent explicit codec: ``encode_message``/``decode_message`` cover the
+# complete message surface of the stack (RBC, ABA, threshold sign/decrypt,
+# subset and honey-badger wrappers, DHB era messages, sender-queue framing).
+# Deterministic, self-delimiting, fuzz-round-trip-tested; the dense-array
+# simulator uses these bytes as its message payload layout.
+
+_MSG_TAGS = {}
+_MSG_DECODERS = {}
+
+
+def _register(tag: int, cls, enc, dec):
+    _MSG_TAGS[cls] = (tag, enc)
+    _MSG_DECODERS[tag] = dec
+
+
+def encode_message(msg) -> bytes:
+    """Any protocol message object → canonical bytes."""
+    _lazy_register()
+    try:
+        tag, enc = _MSG_TAGS[type(msg)]
+    except KeyError:
+        raise TypeError(f"no wire encoding for {type(msg).__name__}")
+    return bytes([tag]) + enc(msg)
+
+
+def decode_message(data: bytes):
+    _lazy_register()
+    r = Reader(data)
+    msg = _read_message(r)
+    if not r.done():
+        raise ValueError("trailing bytes after message")
+    return msg
+
+
+_MAX_NESTING = 8
+
+
+def _read_message(r: Reader):
+    depth = getattr(r, "_depth", 0)
+    if depth >= _MAX_NESTING:
+        raise ValueError("message nesting too deep")
+    r._depth = depth + 1
+    try:
+        tag = r.take(1)[0]
+        try:
+            dec = _MSG_DECODERS[tag]
+        except KeyError:
+            raise ValueError(f"unknown message tag 0x{tag:02x}")
+        return dec(r)
+    finally:
+        r._depth = depth
+
+
+def _lazy_register():
+    """Message classes live across protocol modules that import this one —
+    register on first use to avoid import cycles."""
+    if _MSG_TAGS:
+        return
+    from hbbft_tpu.ops.merkle import Proof
+    from hbbft_tpu.protocols.binary_agreement import (
+        AuxMsg, BValMsg, ConfMsg, CoinMsg, TermMsg,
+    )
+    from hbbft_tpu.protocols.broadcast import EchoMsg, ReadyMsg, ValueMsg
+    from hbbft_tpu.protocols.dynamic_honey_badger import (
+        HbWrap, KeyGenWrap, SignedKeyGenMsg,
+    )
+    from hbbft_tpu.protocols.honey_badger import (
+        DecryptionShareWrap, SubsetWrap,
+    )
+    from hbbft_tpu.protocols.sender_queue import AlgoMessage, EpochStarted
+    from hbbft_tpu.protocols.subset import AgreementWrap, BroadcastWrap
+    from hbbft_tpu.protocols.threshold_decrypt import DecryptionMessage
+    from hbbft_tpu.protocols.threshold_sign import ThresholdSignMessage
+
+    def boolb(v: bool) -> bytes:
+        return b"\x01" if v else b"\x00"
+
+    def read_bool(r: Reader) -> bool:
+        b = r.take(1)
+        if b not in (b"\x00", b"\x01"):
+            raise ValueError("bad bool")
+        return b == b"\x01"
+
+    def proof_bytes(p: Proof) -> bytes:
+        out = blob(p.value) + u32(p.index) + p.root_hash + u32(len(p.path))
+        for digest, on_left in p.path:
+            out += digest + (b"\x01" if on_left else b"\x00")
+        return out
+
+    def read_proof(r: Reader) -> Proof:
+        value = r.blob()
+        index = r.u32()
+        root = r.take(32)
+        n = r.u32()
+        if n > 64:
+            raise ValueError("absurd proof depth")
+        path = tuple((r.take(32), read_bool(r)) for _ in range(n))
+        return Proof(value=value, index=index, root_hash=root, path=path)
+
+    def boolset_byte(s) -> bytes:
+        return bytes([(False in s) | ((True in s) << 1)])
+
+    def read_boolset(r: Reader):
+        b = r.take(1)[0]
+        if b > 3:
+            raise ValueError("bad boolset")
+        out = set()
+        if b & 1:
+            out.add(False)
+        if b & 2:
+            out.add(True)
+        return frozenset(out)
+
+    # RBC ------------------------------------------------------------------
+    _register(0x10, ValueMsg,
+              lambda m: proof_bytes(m.proof),
+              lambda r: ValueMsg(read_proof(r)))
+    _register(0x11, EchoMsg,
+              lambda m: proof_bytes(m.proof),
+              lambda r: EchoMsg(read_proof(r)))
+    _register(0x12, ReadyMsg,
+              lambda m: m.root,
+              lambda r: ReadyMsg(r.take(32)))
+    # ABA ------------------------------------------------------------------
+    _register(0x20, BValMsg,
+              lambda m: u64(m.epoch) + boolb(m.value),
+              lambda r: BValMsg(r.u64(), read_bool(r)))
+    _register(0x21, AuxMsg,
+              lambda m: u64(m.epoch) + boolb(m.value),
+              lambda r: AuxMsg(r.u64(), read_bool(r)))
+    _register(0x22, ConfMsg,
+              lambda m: u64(m.epoch) + boolset_byte(m.values),
+              lambda r: ConfMsg(r.u64(), read_boolset(r)))
+    _register(0x23, TermMsg,
+              lambda m: boolb(m.value),
+              lambda r: TermMsg(read_bool(r)))
+    _register(0x24, CoinMsg,
+              lambda m: u64(m.epoch) + encode_message(m.msg),
+              lambda r: CoinMsg(r.u64(), _read_message(r)))
+    # threshold primitives --------------------------------------------------
+    _register(0x30, ThresholdSignMessage,
+              lambda m: blob(m.share.to_bytes()),
+              lambda r: ThresholdSignMessage(
+                  tc.SignatureShare.from_bytes(r.blob())))
+    _register(0x31, DecryptionMessage,
+              lambda m: blob(m.share.to_bytes()),
+              lambda r: DecryptionMessage(
+                  tc.DecryptionShare.from_bytes(r.blob())))
+    # subset ----------------------------------------------------------------
+    _register(0x40, BroadcastWrap,
+              lambda m: node_id(m.proposer_id) + encode_message(m.msg),
+              lambda r: BroadcastWrap(read_node_id(r), _read_message(r)))
+    _register(0x41, AgreementWrap,
+              lambda m: node_id(m.proposer_id) + encode_message(m.msg),
+              lambda r: AgreementWrap(read_node_id(r), _read_message(r)))
+    # honey badger ----------------------------------------------------------
+    _register(0x50, SubsetWrap,
+              lambda m: u64(m.epoch) + encode_message(m.msg),
+              lambda r: SubsetWrap(r.u64(), _read_message(r)))
+    _register(0x51, DecryptionShareWrap,
+              lambda m: (u64(m.epoch) + node_id(m.proposer_id)
+                         + encode_message(m.msg)),
+              lambda r: DecryptionShareWrap(
+                  r.u64(), read_node_id(r), _read_message(r)))
+    # dynamic honey badger --------------------------------------------------
+    def enc_skg(m: SignedKeyGenMsg) -> bytes:
+        kind = b"\x01" if m.kind == "part" else b"\x02"
+        return (u64(m.era) + node_id(m.sender) + kind + blob(m.payload)
+                + signature(m.sig))
+
+    def dec_skg(r: Reader) -> SignedKeyGenMsg:
+        era = r.u64()
+        sender = read_node_id(r)
+        kb = r.take(1)
+        if kb == b"\x01":
+            kind = "part"
+        elif kb == b"\x02":
+            kind = "ack"
+        else:
+            raise ValueError("bad keygen kind")
+        payload = r.blob()
+        sig = read_signature(r)
+        return SignedKeyGenMsg(era, sender, kind, payload, sig)
+
+    _register(0x60, HbWrap,
+              lambda m: u64(m.era) + encode_message(m.msg),
+              lambda r: HbWrap(r.u64(), _read_message(r)))
+    _register(0x61, KeyGenWrap,
+              lambda m: u64(m.era) + enc_skg(m.msg),
+              lambda r: KeyGenWrap(r.u64(), dec_skg(r)))
+    # sender queue ----------------------------------------------------------
+    _register(0x70, EpochStarted,
+              lambda m: u64(m.key[0]) + u64(m.key[1]),
+              lambda r: EpochStarted((r.u64(), r.u64())))
+    _register(0x71, AlgoMessage,
+              lambda m: encode_message(m.msg),
+              lambda r: AlgoMessage(_read_message(r)))
+
+
+def ensure_registered():
+    _lazy_register()

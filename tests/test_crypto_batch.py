@@ -1,0 +1,56 @@
+"""Randomized-linear-combination batch share verification (device MSM path).
+
+Cross-checks hbbft_tpu.crypto.batch against per-share host verification:
+valid batches accept, any single corrupted share rejects.
+"""
+
+import random
+
+import pytest
+
+pytest.importorskip("jax")
+
+from hbbft_tpu.crypto.batch import (
+    batch_verify_dec_shares,
+    batch_verify_sig_shares,
+)
+from hbbft_tpu.crypto.tc import SecretKeySet
+
+
+@pytest.fixture(scope="module")
+def keys():
+    rng = random.Random(5)
+    sks = SecretKeySet.random(2, rng)
+    return rng, sks, sks.public_keys()
+
+
+def test_sig_share_batch_accepts_valid_and_rejects_bad(keys):
+    rng, sks, pks = keys
+    msg = b"round 3 coin"
+    pairs = [
+        (pks.public_key_share(i), sks.secret_key_share(i).sign(msg))
+        for i in range(6)
+    ]
+    # host per-share ground truth
+    for pk, s in pairs:
+        assert pk.verify(s, msg)
+    assert batch_verify_sig_shares(pairs, msg, rng) is True
+    # swap one share to another node's: each individual is valid BLS but
+    # not for that pk — the batch must reject
+    bad = pairs[:2] + [(pairs[2][0], pairs[3][1])] + pairs[3:]
+    assert batch_verify_sig_shares(bad, msg, rng) is False
+    assert batch_verify_sig_shares([], msg, rng) is True
+
+
+def test_dec_share_batch_accepts_valid_and_rejects_bad(keys):
+    rng, sks, pks = keys
+    ct = pks.public_key().encrypt(b"secret payload", rng)
+    pairs = [
+        (pks.public_key_share(i), sks.secret_key_share(i).decrypt_share(ct))
+        for i in range(5)
+    ]
+    for pk, d in pairs:
+        assert pk.verify_decryption_share(d, ct)
+    assert batch_verify_dec_shares(pairs, ct, rng) is True
+    bad = pairs[:1] + [(pairs[1][0], pairs[2][1])] + pairs[2:]
+    assert batch_verify_dec_shares(bad, ct, rng) is False

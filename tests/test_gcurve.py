@@ -1,0 +1,123 @@
+"""Device (limbed, batched) BLS12-381 vs the pure-Python host oracle.
+
+Exactness is asserted point-for-point: the device field is canonical, so a
+single wrong carry anywhere shows up as inequality.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from hbbft_tpu.crypto import bls12_381 as H
+from hbbft_tpu.ops import fp381 as F
+from hbbft_tpu.ops import gcurve as G
+
+
+def test_fp_ops_exact_incl_edge_cases():
+    rng = np.random.default_rng(0)
+    edge = [0, 1, H.P - 1, H.P - 2, (1 << 377) - 1, (1 << 377),
+            H.P - (1 << 377), 2, (1 << 389) % H.P, ((1 << 390) - 1) % H.P]
+    a_vals = [int.from_bytes(rng.bytes(48), "big") % H.P for _ in range(24)] + edge
+    b_vals = [int.from_bytes(rng.bytes(48), "big") % H.P for _ in range(24)] + list(reversed(edge))
+    n = len(a_vals)
+    A = jnp.asarray(np.stack([F.int_to_limbs(v) for v in a_vals]))
+    B = jnp.asarray(np.stack([F.int_to_limbs(v) for v in b_vals]))
+    add = jax.jit(F.fp_add)(A, B)
+    sub = jax.jit(F.fp_sub)(A, B)
+    mul = jax.jit(F.fp_mul)(A, B)
+    for i in range(n):
+        assert F.limbs_to_int(np.asarray(add[i])) == (a_vals[i] + b_vals[i]) % H.P
+        assert F.limbs_to_int(np.asarray(sub[i])) == (a_vals[i] - b_vals[i]) % H.P
+        assert F.limbs_to_int(np.asarray(mul[i])) == (a_vals[i] * b_vals[i]) % H.P
+
+
+def test_fp2_ops_exact():
+    rng = np.random.default_rng(1)
+    vals = [
+        ((int.from_bytes(rng.bytes(48), "big") % H.P,
+          int.from_bytes(rng.bytes(48), "big") % H.P),
+         (int.from_bytes(rng.bytes(48), "big") % H.P,
+          int.from_bytes(rng.bytes(48), "big") % H.P))
+        for _ in range(16)
+    ]
+    A = (jnp.asarray(np.stack([F.int_to_limbs(a[0]) for a, _ in vals])),
+         jnp.asarray(np.stack([F.int_to_limbs(a[1]) for a, _ in vals])))
+    B = (jnp.asarray(np.stack([F.int_to_limbs(b[0]) for _, b in vals])),
+         jnp.asarray(np.stack([F.int_to_limbs(b[1]) for _, b in vals])))
+    mul = jax.jit(F.fp2_mul)(A, B)
+    sqr = jax.jit(F.fp2_sqr)(A)
+    for i, (a, b) in enumerate(vals):
+        assert F.limbs_to_fp2((np.asarray(mul[0][i]), np.asarray(mul[1][i]))) == H.fp2_mul(a, b)
+        assert F.limbs_to_fp2((np.asarray(sqr[0][i]), np.asarray(sqr[1][i]))) == H.fp2_sqr(a)
+
+
+@pytest.fixture(scope="module")
+def g1_batch():
+    rng = random.Random(7)
+    B = 6
+    pts_h = [H.g1_mul(H.G1_GEN, rng.randrange(1, H.R)) for _ in range(B)]
+    scals = [0, 1, 2, H.R - 1] + [rng.randrange(0, H.R) for _ in range(B - 4)]
+    pts = tuple(jnp.asarray(c) for c in G.g1_to_device(pts_h))
+    bits = jnp.asarray(G.scalars_to_bits(scals))
+    return pts_h, scals, pts, bits
+
+
+def test_g1_add_complete_cases(g1_batch):
+    pts_h, _, pts, _ = g1_batch
+    B = len(pts_h)
+    add_fn = jax.jit(lambda p, q: G.point_add(G.FP_OPS, p, q))
+    cases = {
+        "P+Q": [pts_h[(i + 1) % B] for i in range(B)],
+        "P+P": pts_h,
+        "P+negP": [H.g1_neg(p) for p in pts_h],
+        "P+inf": [None] * B,
+    }
+    for name, qh in cases.items():
+        q = tuple(jnp.asarray(c) for c in G.g1_to_device(qh))
+        r = add_fn(pts, q)
+        for i in range(B):
+            got = G.g1_from_device(tuple(np.asarray(c[i]) for c in r))
+            assert H.g1_eq(got, H.g1_add(pts_h[i], qh[i])), (name, i)
+    # inf + P (batched infinity as first operand)
+    inf = tuple(jnp.asarray(c) for c in G.g1_to_device([None] * B))
+    r = add_fn(inf, pts)
+    for i in range(B):
+        got = G.g1_from_device(tuple(np.asarray(c[i]) for c in r))
+        assert H.g1_eq(got, pts_h[i])
+
+
+def test_g1_msm_ladder_and_tree():
+    """B=2 MSM: exercises the full 255-step ladder AND one device tree-add,
+    with edge scalars, in a single compile."""
+    rng = random.Random(13)
+    cases = [
+        (0, rng.randrange(1, H.R)),
+        (1, H.R - 1),
+        (rng.randrange(1, H.R), rng.randrange(1, H.R)),
+    ]
+    fn = jax.jit(lambda p, b: G.msm(G.FP_OPS, p, b))
+    base = [H.g1_mul(H.G1_GEN, rng.randrange(1, H.R)) for _ in range(2)]
+    pts = tuple(jnp.asarray(c) for c in G.g1_to_device(base))
+    for s0, s1 in cases:
+        bits = jnp.asarray(G.scalars_to_bits([s0, s1]))
+        m = fn(pts, bits)
+        expect = H.g1_add(H.g1_mul(base[0], s0), H.g1_mul(base[1], s1))
+        assert H.g1_eq(G.g1_from_device(tuple(np.asarray(c) for c in m)), expect)
+
+
+def test_g2_msm_ladder_and_tree():
+    rng = random.Random(17)
+    base = [H.g2_mul(H.G2_GEN, rng.randrange(1, H.R)) for _ in range(2)]
+    pts = tuple(tuple(jnp.asarray(x) for x in c) for c in G.g2_to_device(base))
+    s0, s1 = rng.randrange(1, H.R), H.R - 1
+    bits = jnp.asarray(G.scalars_to_bits([s0, s1]))
+    m = jax.jit(lambda p, b: G.msm(G.FP2_OPS, p, b))(pts, bits)
+    expect = H.g2_add(H.g2_mul(base[0], s0), H.g2_mul(base[1], s1))
+    assert H.g2_eq(
+        G.g2_from_device(tuple(tuple(np.asarray(x) for x in c) for c in m)),
+        expect,
+    )
