@@ -33,8 +33,9 @@ class FaultKind(enum.Enum):
     NotAProposer = "broadcast: Value message from a node that is not the proposer"
     UnknownSender = "message from a node that is not on the network"
     # binary agreement
-    DuplicateBVal = "binary_agreement: duplicate BVal from a node"
-    DuplicateAux = "binary_agreement: duplicate Aux from a node"
+    # (the reference's DuplicateBVal/DuplicateAux are intentionally absent:
+    # Term substitutes for its sender's BVal/Aux here, so same-value repeats
+    # are indistinguishable from honest reordering and are treated as benign)
     MultipleConf = "binary_agreement: multiple Conf from a node"
     MultipleTerm = "binary_agreement: multiple Term from a node"
     AgreementEpochMismatch = "binary_agreement: message for an impossible epoch"

@@ -95,8 +95,13 @@ class SbvBroadcast:
         self, sender: NodeId, value: bool
     ) -> Tuple[List[Tuple[str, bool]], Optional[FaultKind]]:
         if sender in self.bval_received[value]:
-            return [], None  # network replay — idempotent (both bools are
-            # legal from one sender in MMR, so a same-value repeat is benign)
+            # Same-value repeat: benign, NOT evidence.  A Term legitimately
+            # substitutes for its sender's BVal/Aux (see _handle_term), so
+            # under reordering an honest node's genuine BVal can arrive
+            # after its Term already seeded these sets — faulting repeats
+            # would accuse honest nodes.  (The reference's DuplicateBVal
+            # fault kind is therefore intentionally not reproduced.)
+            return [], None
         self.bval_received[value].add(sender)
         out: List[Tuple[str, bool]] = []
         count = len(self.bval_received[value])
@@ -114,7 +119,7 @@ class SbvBroadcast:
         self, sender: NodeId, value: bool
     ) -> Optional[FaultKind]:
         if sender in self.aux_received[value]:
-            return None  # network replay — idempotent
+            return None  # benign repeat — see handle_bval
         self.aux_received[value].add(sender)
         return None
 

@@ -11,6 +11,7 @@ cross-checked against.
 
 from hbbft_tpu.sim.adversary import (
     Adversary,
+    MitmDelayAdversary,
     NodeOrderAdversary,
     NullAdversary,
     RandomAdversary,

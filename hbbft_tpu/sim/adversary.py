@@ -63,20 +63,117 @@ class ReorderingAdversary(Adversary):
         return 0
 
 
-class RandomAdversary(Adversary):
-    """Random delivery order with occasional duplication of messages.
+class MitmDelayAdversary(Adversary):
+    """Man-in-the-middle delay schedule against binary agreement.
 
-    Reference: ``RandomAdversary`` — random schedule plus message replays;
-    protocols must be idempotent against duplicates.
+    Reference: ``tests/binary_agreement_mitm.rs`` — the Moumen-style attack:
+    hold back every message to/from a targeted node for as long as the
+    budget allows so its estimate keeps lagging the coin.  With a threshold
+    (unpredictable) coin the protocol must still terminate; a predictable
+    coin could be stalled forever.
     """
 
-    def __init__(self, seed: int = 0, dup_prob: float = 0.05):
+    def __init__(self, target, max_delay: int = 200, seed: int = 0):
+        self.target = target
+        self.max_delay = max_delay
         self.rng = random.Random(seed)
-        self.dup_prob = dup_prob
+        self._held = 0
 
     def pick_message(self, net: "VirtualNet") -> int:
+        others = [
+            i for i, m in enumerate(net.queue)
+            if m.to != self.target and m.sender != self.target
+        ]
+        if others and self._held < self.max_delay:
+            self._held += 1
+            return self.rng.choice(others)
+        self._held = 0
+        return self.rng.randrange(len(net.queue))
+
+
+class RandomAdversary(Adversary):
+    """Random delivery order with duplication, INJECTION, and TAMPERING.
+
+    Reference: ``RandomAdversary`` — random schedule plus replays, randomly
+    mutated copies of in-flight messages re-sent under faulty identities,
+    and field-level tampering of faulty nodes' outgoing messages.  Correct
+    nodes must treat all of it as noise: at worst the culprits land in
+    fault logs; agreement/termination must be unaffected.
+    """
+
+    def __init__(self, seed: int = 0, dup_prob: float = 0.05,
+                 inject_prob: float = 0.05, tamper_prob: float = 0.3):
+        self.rng = random.Random(seed)
+        self.dup_prob = dup_prob
+        self.inject_prob = inject_prob
+        self.tamper_prob = tamper_prob
+
+    def pick_message(self, net: "VirtualNet") -> int:
+        from hbbft_tpu.sim.virtual_net import NetworkMessage
+
         i = self.rng.randrange(len(net.queue))
         if self.rng.random() < self.dup_prob:
             # duplicate: re-enqueue a copy before delivery
             net.queue.append(net.queue[i])
+        faulty = [n for n in net.node_ids() if net.nodes[n].is_faulty]
+        if faulty and self.rng.random() < self.inject_prob:
+            # inject: a mutated copy of a random in-flight message, re-sent
+            # under a faulty identity to a random destination
+            src = self.rng.choice(faulty)
+            template = self.rng.choice(net.queue)
+            payload = self._mutate(template.payload)
+            dst = self.rng.choice(net.node_ids())
+            net.queue.append(NetworkMessage(src, dst, payload))
         return i
+
+    def tamper(self, net: "VirtualNet", msg: "NetworkMessage"):
+        """Faulty senders' messages: drop some, corrupt some fields."""
+        from hbbft_tpu.sim.virtual_net import NetworkMessage
+
+        roll = self.rng.random()
+        if roll < self.tamper_prob / 3:
+            return None  # drop
+        if roll < self.tamper_prob:
+            return NetworkMessage(
+                msg.sender, msg.to, self._mutate(msg.payload)
+            )
+        return msg
+
+    def _mutate(self, msg):
+        """Type-aware field corruption of protocol messages (falls back to
+        the original object for unknown/deeply-nested types)."""
+        import dataclasses
+
+        from hbbft_tpu.protocols.binary_agreement import (
+            AuxMsg, BValMsg, ConfMsg, TermMsg,
+        )
+        from hbbft_tpu.protocols.broadcast import EchoMsg, ReadyMsg, ValueMsg
+
+        r = self.rng
+        if isinstance(msg, (BValMsg, AuxMsg)):
+            if r.random() < 0.5:
+                return dataclasses.replace(msg, value=not msg.value)
+            return dataclasses.replace(msg, epoch=msg.epoch + r.randrange(1, 3))
+        if isinstance(msg, TermMsg):
+            return dataclasses.replace(msg, value=not msg.value)
+        if isinstance(msg, ConfMsg):
+            return dataclasses.replace(
+                msg, values=frozenset([r.random() < 0.5])
+            )
+        if isinstance(msg, ReadyMsg):
+            root = bytearray(msg.root)
+            root[r.randrange(len(root))] ^= 1 << r.randrange(8)
+            return ReadyMsg(bytes(root))
+        if isinstance(msg, (ValueMsg, EchoMsg)):
+            proof = msg.proof
+            value = bytearray(proof.value)
+            if value:
+                value[r.randrange(len(value))] ^= 1 << r.randrange(8)
+            bad = dataclasses.replace(proof, value=bytes(value))
+            return type(msg)(bad)
+        if dataclasses.is_dataclass(msg) and hasattr(msg, "msg"):
+            try:
+                return dataclasses.replace(msg, msg=self._mutate(msg.msg))
+            except Exception:
+                return msg
+        return msg

@@ -1,0 +1,166 @@
+"""Property-based network-dimension coverage + determinism.
+
+Reference: ``tests/net/proptest.rs :: NetworkDimension`` — (n, f) pairs
+with f ≤ ⌊(n−1)/3⌋ sampled by proptest; and the determinism discipline of
+SURVEY §5 ("race detection"): same seed ⇒ bit-identical full message trace.
+"""
+
+import random
+
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from hbbft_tpu.netinfo import NetworkInfo
+from hbbft_tpu.protocols import wire
+from hbbft_tpu.protocols.binary_agreement import BinaryAgreement
+from hbbft_tpu.protocols.broadcast import Broadcast
+from hbbft_tpu.protocols.honey_badger import (
+    Batch,
+    EncryptionSchedule,
+    HoneyBadger,
+)
+from hbbft_tpu.protocols.subset import Contribution, Done, Subset
+from hbbft_tpu.sim import NetBuilder, RandomAdversary, ReorderingAdversary
+
+_INFO_CACHE = {}
+
+
+def infos_for(n, seed=21):
+    key = (n, seed)
+    if key not in _INFO_CACHE:
+        _INFO_CACHE[key] = NetworkInfo.generate_map(
+            list(range(n)), random.Random(seed)
+        )
+    return _INFO_CACHE[key]
+
+
+def network_dimension():
+    """(n, f) with 1 ≤ n ≤ 10 and f ≤ ⌊(n−1)/3⌋, like the reference's
+    proptest ``NetworkDimension`` strategy."""
+    return st.integers(min_value=1, max_value=10).flatmap(
+        lambda n: st.tuples(
+            st.just(n), st.integers(min_value=0, max_value=(n - 1) // 3)
+        )
+    )
+
+
+@settings(max_examples=12, deadline=None)
+@given(dim=network_dimension(), seed=st.integers(0, 2**16))
+def test_broadcast_any_dimension(dim, seed):
+    n, f = dim
+    infos = infos_for(n)
+    net = (
+        NetBuilder(list(range(n)))
+        .num_faulty(f)
+        .adversary(ReorderingAdversary(seed=seed))
+        .using_step(lambda nid: Broadcast(infos[nid], n - 1))
+    )
+    # proposer is the last id — never in the first-f faulty set unless n
+    # small; faulty here means adversary-routed, not silent
+    net.send_input(n - 1, b"dim value")
+    net.run_to_quiescence()
+    correct = [nid for nid in net.node_ids() if not net.nodes[nid].is_faulty]
+    decided = [
+        tuple(net.nodes[nid].outputs) for nid in correct if net.nodes[nid].outputs
+    ]
+    assert len(set(decided)) <= 1
+    if n - 1 not in [nid for nid in net.node_ids() if net.nodes[nid].is_faulty]:
+        assert all(d == (b"dim value",) for d in decided)
+        assert len(decided) == len(correct)
+
+
+@settings(max_examples=8, deadline=None)
+@given(dim=network_dimension(), seed=st.integers(0, 2**16))
+def test_binary_agreement_any_dimension(dim, seed):
+    n, f = dim
+    infos = infos_for(n)
+    rng = random.Random(seed)
+    net = (
+        NetBuilder(list(range(n)))
+        .adversary(ReorderingAdversary(seed=seed))
+        .crank_limit(500_000)
+        .using_step(lambda nid: BinaryAgreement(infos[nid], b"dim", 0))
+    )
+    inputs = {nid: rng.random() < 0.5 for nid in range(n)}
+    for nid, b in inputs.items():
+        net.send_input(nid, b)
+    net.run_to_quiescence()
+    decisions = {
+        net.nodes[nid].outputs[0]
+        for nid in net.node_ids()
+        if net.nodes[nid].outputs
+    }
+    assert len(decisions) == 1
+    if len(set(inputs.values())) == 1:
+        assert decisions == set(inputs.values())
+
+
+@settings(max_examples=5, deadline=None)
+@given(dim=network_dimension(), seed=st.integers(0, 2**16))
+def test_subset_any_dimension(dim, seed):
+    n, f = dim
+    infos = infos_for(n)
+    net = (
+        NetBuilder(list(range(n)))
+        .adversary(ReorderingAdversary(seed=seed))
+        .crank_limit(1_000_000)
+        .using_step(lambda nid: Subset(infos[nid], session_id=b"dim-acs"))
+    )
+    for nid in range(n):
+        net.send_input(nid, b"contrib-%d" % nid)
+    net.run_to_quiescence()
+    per_node = []
+    for nid in net.node_ids():
+        contribs = {
+            (o.proposer_id, o.value)
+            for o in net.nodes[nid].outputs
+            if isinstance(o, Contribution)
+        }
+        assert any(isinstance(o, Done) for o in net.nodes[nid].outputs), nid
+        per_node.append(frozenset(contribs))
+    assert len(set(per_node)) == 1  # same accepted set everywhere
+    assert len(per_node[0]) >= n - f
+
+
+def _run_traced_hb(n, seed):
+    """Run one HB epoch recording the full canonical message trace."""
+    infos = infos_for(n)
+    net = (
+        NetBuilder(list(range(n)))
+        .adversary(RandomAdversary(seed=seed))
+        .using_step(
+            lambda nid: HoneyBadger.builder(infos[nid])
+            .session_id(b"det")
+            .encryption_schedule(EncryptionSchedule.always())
+            .rng(random.Random(seed * 1000 + nid))
+            .build()
+        )
+    )
+    for nid in net.node_ids():
+        net.send_input(nid, b"det-contrib-%d" % nid)
+    trace = []
+    while net.queue:
+        m = net.crank()
+        if m is not None:
+            trace.append(
+                (m.sender, m.to, wire.encode_message(m.payload))
+            )
+    batches = {
+        nid: [o for o in net.nodes[nid].outputs if isinstance(o, Batch)]
+        for nid in net.node_ids()
+    }
+    return trace, batches
+
+
+def test_same_seed_identical_full_trace():
+    """Determinism is the race detector (SURVEY §5): two runs from one seed
+    must produce byte-identical message traces and outputs."""
+    t1, b1 = _run_traced_hb(4, seed=5)
+    t2, b2 = _run_traced_hb(4, seed=5)
+    assert t1 == t2
+    assert b1 == b2
+    assert len(t1) > 100
+    # and a different seed takes a different path (sanity that the trace
+    # comparison is not vacuous)
+    t3, _ = _run_traced_hb(4, seed=6)
+    assert t3 != t1
