@@ -357,7 +357,67 @@ def bench_coin256(n: int = 256, f: int = 85):
     }
 
 
+def bench_hb_epoch(n: int = 16, tx_bytes: int = 256):
+    """A FULL batched HoneyBadger epoch (TPKE encrypt → batched RBC round →
+    batched ABA epochs → threshold decrypt) vs the object-mode simulator
+    running the same epoch message-by-message (BASELINE config-1 shape,
+    scaled up to N=16)."""
+    import random
+
+    from hbbft_tpu.netinfo import NetworkInfo
+    from hbbft_tpu.parallel.acs import BatchedHoneyBadgerEpoch
+    from hbbft_tpu.protocols.honey_badger import (
+        Batch, EncryptionSchedule, HoneyBadger,
+    )
+    from hbbft_tpu.sim import NetBuilder, NullAdversary
+
+    rng = random.Random(17)
+    print(f"# hb-epoch: generating keys for N={n}…", file=sys.stderr)
+    infos = NetworkInfo.generate_map(list(range(n)), rng)
+    contribs = {
+        i: bytes(rng.randrange(256) for _ in range(tx_bytes)) for i in range(n)
+    }
+
+    hb = BatchedHoneyBadgerEpoch(infos, session_id=b"bench")
+    batch0, _ = hb.run(contribs, random.Random(1), encrypt=True)  # warm/compile
+    assert batch0 == contribs
+    times = []
+    for i in range(3):
+        t0 = time.perf_counter()
+        batch, _ = hb.run(contribs, random.Random(2 + i), encrypt=True)
+        times.append(time.perf_counter() - t0)
+        assert batch == contribs
+    t_dev = float(np.median(times))
+
+    def host_once():
+        net = NetBuilder(list(range(n))).adversary(NullAdversary()).using_step(
+            lambda nid: HoneyBadger.builder(infos[nid])
+            .session_id(b"bench")
+            .encryption_schedule(EncryptionSchedule.always())
+            .rng(random.Random(100 + nid))
+            .build()
+        )
+        for nid in net.node_ids():
+            net.send_input(nid, contribs[nid])
+        net.run_to_quiescence()
+        for nid in net.node_ids():
+            batches = [o for o in net.nodes[nid].outputs if isinstance(o, Batch)]
+            assert len(batches) == 1
+
+    t_host = _timeit(host_once, warmup=1, iters=2, min_time=0.0)
+    return {
+        "metric": "hb_epoch_batched",
+        "value": round(1.0 / t_dev, 3),
+        "unit": "epochs/s",
+        "vs_baseline": round(t_host / t_dev, 2),
+        "t_device_s": round(t_dev, 6),
+        "t_host_s": round(t_host, 6),
+        "shape": f"N={n} tx={tx_bytes}B",
+    }
+
+
 CONFIGS = {
+    "hb-epoch": bench_hb_epoch,
     "rbc-round": bench_rbc_round,
     "rbc64": bench_rbc64,
     "rbc64-reconstruct": bench_rbc64_reconstruct,
@@ -391,7 +451,8 @@ def main(argv=None):
         print(f"# {json.dumps(r)}", file=sys.stderr)
         results.append(r)
 
-    # Headline = the full RBC pipeline number; detail rows ride along.
+    # Headline = the FIRST config (the full batched HB epoch under
+    # --config all); detail rows carry the rest.
     head = results[0]
     line = {
         "metric": head["metric"],

@@ -12,8 +12,13 @@ keccak sweeps, and shards across TPU devices via ``shard_map`` with
 
 Modules:
 - :mod:`hbbft_tpu.parallel.rbc` — batched Bracha reliable broadcast rounds.
+- :mod:`hbbft_tpu.parallel.aba` — batched binary-agreement epochs.
+- :mod:`hbbft_tpu.parallel.acs` — ACS composition and the full batched
+  HoneyBadger epoch (encrypt → RBC → ABA → decrypt).
 - :mod:`hbbft_tpu.parallel.mesh` — ``shard_map`` wrappers placing the node
   axis across a device mesh.
 """
 
+from hbbft_tpu.parallel.aba import BatchedAba  # noqa: F401
+from hbbft_tpu.parallel.acs import BatchedAcs, BatchedHoneyBadgerEpoch  # noqa: F401
 from hbbft_tpu.parallel.rbc import BatchedRbc  # noqa: F401

@@ -1,0 +1,217 @@
+"""Batched binary agreement as dense array epochs.
+
+Reference semantics: ``src/binary_agreement/`` (object-mode mirror:
+:mod:`hbbft_tpu.protocols.binary_agreement`).  One *epoch* of ALL N nodes ×
+P instances executes as a single jitted array program under the
+bulk-synchronous model (every message of a sub-round delivered in one step,
+adversarial drops as masks):
+
+- SBV: BVal one-hots over (node, instance, value) with the f+1 relay and
+  2f+1 bin_values rules iterated to fixpoint (monotone; n rounds cover the
+  longest relay chains partial delivery masks can build);
+- Aux support counted over senders whose value landed in the receiver's
+  bin_values; Conf as a 2-bit set with the ⊆-bin_values filter;
+- the Moumen coin schedule (epochs 0, 1 mod 3 fixed true/false; every third
+  a threshold coin).  The random coin value is an INPUT to the jitted epoch
+  (`coin_bits`): in simulation it is produced once per (instance, epoch) by
+  combining t+1 real signature shares on the host/native oracle — the
+  per-node share-verify redundancy of a real deployment is accounted by the
+  cost model, not re-executed N times (SURVEY §5's cost-model hook);
+- the MMR decision rule and Term seeding: deciders participate in later
+  epochs through their recorded Terms, exactly like object-mode
+  ``_next_epoch``.
+
+Documented bulk-sync divergence from object mode: when both values enter
+``bin_values`` in the same sub-round, the object implementation's Aux choice
+depends on message arrival order; here it deterministically prefers True.
+Either choice is protocol-valid (agreement/validity/termination hold).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class BatchedAba:
+    """Batched ABA epochs for an (n, f) network, P instances."""
+
+    def __init__(self, n: int, f: int):
+        self.n = n
+        self.f = f
+
+    def init_state(self, est):
+        """est: bool (N, P) initial estimates (input of every node/instance).
+
+        Returns the dense state dict: ``est``, ``decided``, ``decision``
+        (bool (N, P); deciders participate in later epochs through their
+        decision, the Term analogue) and ``epoch`` (scalar int32).
+        """
+        import jax.numpy as jnp
+
+        est = jnp.asarray(est, dtype=bool)
+        z = jnp.zeros(est.shape, dtype=bool)
+        return {
+            "est": est,
+            "decided": z,
+            "decision": z,
+            "epoch": jnp.zeros((), dtype=jnp.int32),
+        }
+
+    def epoch_step(self, state, coin_bits, bval_mask=None, aux_mask=None,
+                   conf_mask=None):
+        """One bulk-synchronous ABA epoch for all (node, instance).
+
+        coin_bits: bool (P,) — the threshold-coin value per instance for
+        this epoch (ignored on fixed-schedule epochs).
+        Masks: bool (N_src, N_dst, P) deliveries (default all-delivered).
+        Returns the next state.
+        """
+        import jax.numpy as jnp
+
+        n, f = self.n, self.f
+        est = state["est"]
+        decided = state["decided"]
+        decision = state["decision"]
+        P = est.shape[1]
+
+        if bval_mask is None:
+            bval_mask = jnp.ones((n, n, P), dtype=bool)
+        if aux_mask is None:
+            aux_mask = jnp.ones((n, n, P), dtype=bool)
+        if conf_mask is None:
+            conf_mask = jnp.ones((n, n, P), dtype=bool)
+        eye = jnp.eye(n, dtype=bool)[:, :, None]
+        bval_mask = bval_mask | eye
+        aux_mask = aux_mask | eye
+        conf_mask = conf_mask | eye
+
+        # -- SBV: BVal one-hots (N, P, 2); deciders vote their Term --------
+        active = ~decided
+        val_axis = jnp.stack([~est, est], axis=-1)  # [..., v] = est == v
+        term_axis = jnp.stack([~decision, decision], axis=-1)
+        sent = jnp.where(decided[..., None], term_axis, val_axis)
+
+        # f+1 relay / 2f+1 bin_values to fixpoint — monotone, but relay
+        # chains can be up to ~n hops long under partial delivery masks
+        # (same reason rbc.py iterates its Ready amplification n times)
+        import jax
+
+        def relay(_, s):
+            cnt = jnp.einsum(
+                "ipv,ijp->jpv", s.astype(jnp.int32),
+                bval_mask.astype(jnp.int32),
+            )
+            return s | (cnt >= (f + 1))
+
+        sent = jax.lax.fori_loop(0, n, relay, sent)
+        cnt = jnp.einsum(
+            "ipv,ijp->jpv", sent.astype(jnp.int32),
+            bval_mask.astype(jnp.int32),
+        )
+        bin_vals = cnt >= (2 * f + 1)  # (N, P, 2) per receiver
+
+        # -- Aux: first bin_value (True-preference); deciders send Term val
+        has_any = bin_vals.any(axis=-1)
+        aux_val = jnp.where(decided, decision, bin_vals[..., 1])  # True pref
+        aux_sent = has_any | decided
+        # support at receiver j: senders i whose aux value ∈ bin_vals[j]
+        aux_v = jnp.stack([~aux_val, aux_val], axis=-1) & aux_sent[..., None]
+        deliv = aux_mask  # (i, j, p)
+        # sender i's aux value v counts at j iff bin_vals[j, p, v]
+        support = jnp.einsum(
+            "ipv,ijp,jpv->jp", aux_v.astype(jnp.int32),
+            deliv.astype(jnp.int32), bin_vals.astype(jnp.int32),
+        )
+        # senders (not sender×value) — aux is a single value per sender, so
+        # the einsum over v counts each supporting sender once
+        vals = bin_vals & (
+            jnp.einsum(
+                "ipv,ijp->jpv", aux_v.astype(jnp.int32),
+                deliv.astype(jnp.int32),
+            )
+            > 0
+        )
+        sbv_done = support >= (n - f)
+
+        # -- Conf: 2-bit sets; count confs ⊆ receiver's bin_vals ----------
+        conf = jnp.where(
+            decided[..., None],
+            term_axis,
+            vals,
+        )  # (N, P, 2) sender's conf set
+        # subset test: conf_i ⊆ bin_j  ⟺  conf_i & ~bin_j empty
+        viol = jnp.einsum(
+            "ipv,jpv->ijp", conf.astype(jnp.int32),
+            (~bin_vals).astype(jnp.int32),
+        )
+        sent_conf = sbv_done | decided
+        conf_count = (
+            (viol == 0) & conf_mask & sent_conf[:, None, :]
+        ).sum(axis=0)
+        conf_done = conf_count >= (n - f)
+
+        # -- coin ----------------------------------------------------------
+        m = state["epoch"] % 3
+        coin = jnp.where(
+            m == 0,
+            jnp.ones((P,), dtype=bool),
+            jnp.where(m == 1, jnp.zeros((P,), dtype=bool), coin_bits),
+        )  # (P,)
+        coin_b = jnp.broadcast_to(coin[None, :], est.shape)
+
+        # -- MMR decision rule (only where conf_done & active) -------------
+        only_true = vals[..., 1] & ~vals[..., 0]
+        only_false = vals[..., 0] & ~vals[..., 1]
+        both = vals[..., 0] & vals[..., 1]
+        vals_single = only_true | only_false
+        vals_val = only_true  # the singleton's value (valid when single)
+        ready = conf_done & sbv_done & active
+        decide_now = ready & vals_single & (vals_val == coin_b)
+        new_est = jnp.where(
+            vals_single, vals_val, coin_b
+        )  # singleton carries; BOTH adopts coin
+        est = jnp.where(ready, new_est, est)
+        decision = jnp.where(decide_now, coin_b, decision)
+        decided = decided | decide_now
+
+        # f+1 Terms rule: laggards adopt a value with f+1 deciders
+        for v in (False, True):
+            term_cnt = (decided & (decision == v)).sum(axis=0)  # (P,)
+            adopt = active & (term_cnt >= (f + 1))[None, :] & ~decided
+            decision = jnp.where(adopt, v, decision)
+            decided = decided | adopt
+
+        return {
+            "est": est,
+            "decided": decided,
+            "decision": decision,
+            "epoch": state["epoch"] + 1,
+        }
+
+
+def coin_for(netinfo_map, session_id: bytes, proposer_id, epoch: int) -> bool:
+    """The threshold-coin value for (instance, epoch) — computed once by
+    combining t+1 REAL signature shares (host/native), as the simulator's
+    god-view shortcut for the N-redundant share exchange."""
+    from hbbft_tpu.crypto import tc
+
+    nonce = (
+        b"HBBFT-ABA-COIN"
+        + struct.pack(">I", len(session_id))
+        + session_id
+        + repr(proposer_id).encode()
+        + struct.pack(">Q", epoch)
+    )
+    infos = list(netinfo_map.values())
+    pks = infos[0].public_key_set()
+    t = pks.threshold()
+    shares = {}
+    ids = sorted(netinfo_map.keys(), key=repr)
+    for nid in ids[: t + 1]:
+        info = netinfo_map[nid]
+        shares[info.node_index(nid)] = info.secret_key_share().sign(nonce)
+    return pks.combine_signatures(shares).parity()

@@ -1,0 +1,130 @@
+"""Batched ABA / ACS / HoneyBadger-epoch vs properties and object mode.
+
+The batched pipeline must (a) satisfy agreement/validity/termination on
+its own, and (b) commit the same batch as the object-mode HoneyBadger on
+the same inputs (happy path and crashed-proposer cases).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from hbbft_tpu.netinfo import NetworkInfo
+from hbbft_tpu.parallel.aba import BatchedAba
+from hbbft_tpu.parallel.acs import BatchedAcs, BatchedHoneyBadgerEpoch
+from hbbft_tpu.parallel.rbc import unframe_value
+from hbbft_tpu.protocols.honey_badger import (
+    Batch,
+    EncryptionSchedule,
+    HoneyBadger,
+)
+from hbbft_tpu.sim import NetBuilder, NullAdversary
+
+_INFO_CACHE = {}
+
+
+def infos_for(n, seed=13):
+    key = (n, seed)
+    if key not in _INFO_CACHE:
+        _INFO_CACHE[key] = NetworkInfo.generate_map(
+            list(range(n)), random.Random(seed)
+        )
+    return _INFO_CACHE[key]
+
+
+def run_aba(n, f, est0, coins, max_epochs=12):
+    aba = BatchedAba(n, f)
+    st = aba.init_state(jnp.asarray(est0))
+    step = jax.jit(aba.epoch_step)
+    for e in range(max_epochs):
+        st = step(st, jnp.asarray(coins(e)))
+        if bool(np.asarray(st["decided"]).all()):
+            break
+    return {k: np.asarray(v) for k, v in st.items()}
+
+
+@pytest.mark.parametrize("n,f", [(4, 1), (7, 2), (10, 3)])
+def test_batched_aba_validity_and_agreement(n, f):
+    P = n
+    # unanimous true: epoch-0 fixed coin true → immediate decision
+    st = run_aba(n, f, np.ones((n, P), bool), lambda e: np.zeros(P, bool))
+    assert st["decided"].all() and st["decision"].all() and st["epoch"] == 1
+    # unanimous false: decides false on the epoch-1 fixed coin
+    st = run_aba(n, f, np.zeros((n, P), bool), lambda e: np.zeros(P, bool))
+    assert st["decided"].all() and not st["decision"].any()
+    # mixed inputs: agreement per instance, termination
+    rng = np.random.default_rng(n)
+    st = run_aba(
+        n, f, rng.random((n, P)) < 0.5, lambda e: rng.random(P) < 0.5
+    )
+    assert st["decided"].all()
+    for p in range(P):
+        assert len(set(st["decision"][:, p])) == 1
+
+
+def test_batched_acs_happy_path_and_agreement():
+    n, f = 7, 2
+    acs = BatchedAcs(n, f)
+    values = [b"v%d" % p * (p + 1) for p in range(n)]
+    out = acs.run(values)
+    acc = out["accepted"]
+    assert (acc == acc[0]).all()
+    assert acc[0].all()
+    for p in range(n):
+        assert unframe_value(out["data"][0, p]) == values[p]
+
+
+def test_batched_acs_excludes_crashed_proposers():
+    n, f = 7, 2
+    acs = BatchedAcs(n, f)
+    values = [b"v%d" % p for p in range(n)]
+    vm = np.ones((n, n), bool)
+    vm[0, :] = False  # proposer 0 crashes before sending Values
+    # (the proposer's own Value is always self-delivered, so excluding 4
+    # others leaves 4 < n−f = 5 echoes)
+    vm[5, 3:] = False
+    out = acs.run(values, value_mask=jnp.asarray(vm))
+    acc = out["accepted"]
+    assert (acc == acc[0]).all()
+    assert not acc[0][0] and not acc[0][5]
+    assert acc[0].sum() >= n - f
+    for p in np.flatnonzero(acc[0]):
+        assert unframe_value(out["data"][0, p]) == values[p]
+
+
+@pytest.mark.parametrize("encrypt", [True, False], ids=["tpke", "plain"])
+def test_batched_hb_epoch_matches_object_mode(encrypt):
+    n = 4
+    infos = infos_for(n)
+    contribs = {i: f"contribution-{i}".encode() for i in range(n)}
+
+    # batched epoch
+    hb = BatchedHoneyBadgerEpoch(infos, session_id=b"hb-test")
+    batch_b, detail = hb.run(contribs, random.Random(7), encrypt=encrypt)
+    acc = detail["accepted"]
+    assert (acc == acc[0]).all()
+
+    # object mode, same contributions
+    sched = (
+        EncryptionSchedule.always() if encrypt else EncryptionSchedule.never()
+    )
+    net = NetBuilder(list(range(n))).adversary(NullAdversary()).using_step(
+        lambda nid: HoneyBadger.builder(infos[nid])
+        .session_id(b"hb-test")
+        .encryption_schedule(sched)
+        .rng(random.Random(1000 + nid))
+        .build()
+    )
+    for nid in net.node_ids():
+        net.send_input(nid, contribs[nid])
+    net.run_to_quiescence()
+    object_batches = [
+        [o for o in net.nodes[nid].outputs if isinstance(o, Batch)]
+        for nid in net.node_ids()
+    ]
+    assert all(len(b) == 1 for b in object_batches)
+    assert batch_b == object_batches[0][0].contributions_map()
