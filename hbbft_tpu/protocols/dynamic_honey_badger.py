@@ -384,6 +384,45 @@ class KeyGenWrap:
     msg: SignedKeyGenMsg
 
 
+class DynamicHoneyBadgerBuilder:
+    """Reference: ``dynamic_honey_badger/builder.rs`` — the same typed knobs
+    (era, rng, encryption schedule, epoch window)."""
+
+    def __init__(self, netinfo: NetworkInfo, secret_key: tc.SecretKey):
+        self._netinfo = netinfo
+        self._secret_key = secret_key
+        self._era = 0
+        self._rng: Optional[random.Random] = None
+        self._schedule: Optional[EncryptionSchedule] = None
+        self._max_future_epochs = 3
+
+    def era(self, era: int) -> "DynamicHoneyBadgerBuilder":
+        self._era = era
+        return self
+
+    def rng(self, rng: random.Random) -> "DynamicHoneyBadgerBuilder":
+        self._rng = rng
+        return self
+
+    def encryption_schedule(self, s: EncryptionSchedule) -> "DynamicHoneyBadgerBuilder":
+        self._schedule = s
+        return self
+
+    def max_future_epochs(self, n: int) -> "DynamicHoneyBadgerBuilder":
+        self._max_future_epochs = n
+        return self
+
+    def build(self) -> "DynamicHoneyBadger":
+        return DynamicHoneyBadger(
+            self._netinfo,
+            self._secret_key,
+            era=self._era,
+            rng=self._rng,
+            encryption_schedule=self._schedule,
+            max_future_epochs=self._max_future_epochs,
+        )
+
+
 class DynamicHoneyBadger(ConsensusProtocol):
     """Reference: ``dynamic_honey_badger.rs :: DynamicHoneyBadger<C, N>``."""
 
@@ -419,6 +458,10 @@ class DynamicHoneyBadger(ConsensusProtocol):
         self.hb = self._make_hb()
 
     @classmethod
+    def builder(cls, netinfo: NetworkInfo, secret_key: tc.SecretKey) -> "DynamicHoneyBadgerBuilder":
+        return DynamicHoneyBadgerBuilder(netinfo, secret_key)
+
+    @classmethod
     def from_join_plan(
         cls,
         our_id: NodeId,
@@ -451,6 +494,15 @@ class DynamicHoneyBadger(ConsensusProtocol):
             encryption_schedule=self.encryption_schedule,
             rng=random.Random(self.rng.getrandbits(64)),
         )
+
+    # -- pickling (snapshot/restore support) ---------------------------------
+
+    def __getstate__(self):
+        # contribution_provider is a closure installed by wrappers
+        # (QueueingHoneyBadger) — drop it; the wrapper reinstalls on restore
+        d = self.__dict__.copy()
+        d["contribution_provider"] = None
+        return d
 
     # -- ConsensusProtocol ---------------------------------------------------
 

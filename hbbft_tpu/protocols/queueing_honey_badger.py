@@ -111,6 +111,37 @@ class TxInput:
     tx: bytes
 
 
+class QueueingHoneyBadgerBuilder:
+    """Reference: ``queueing_honey_badger.rs :: QueueingHoneyBadgerBuilder``
+    (batch_size + rng + queue knobs over a DynamicHoneyBadger)."""
+
+    def __init__(self, dhb):
+        self._dhb = dhb
+        self._batch_size = 100
+        self._rng = None
+        self._queue = None
+
+    def batch_size(self, n: int) -> "QueueingHoneyBadgerBuilder":
+        self._batch_size = n
+        return self
+
+    def rng(self, rng) -> "QueueingHoneyBadgerBuilder":
+        self._rng = rng
+        return self
+
+    def queue(self, q) -> "QueueingHoneyBadgerBuilder":
+        self._queue = q
+        return self
+
+    def build(self) -> "QueueingHoneyBadger":
+        return QueueingHoneyBadger(
+            self._dhb,
+            batch_size=self._batch_size,
+            rng=self._rng,
+            queue=self._queue,
+        )
+
+
 class QueueingHoneyBadger(ConsensusProtocol):
     """Reference: ``queueing_honey_badger.rs :: QueueingHoneyBadger<T,N,Q>``."""
 
@@ -127,9 +158,21 @@ class QueueingHoneyBadger(ConsensusProtocol):
         self.queue = queue or TransactionQueue()
         self.dhb.empty_contribution = _ser_txs([])
         # DHB's DKG keep-alive proposes REAL transactions, not empties
+        self._install_provider()
+
+    def _install_provider(self) -> None:
         self.dhb.contribution_provider = lambda: _ser_txs(
             self.queue.choose(self.rng, self.batch_size)
         )
+
+    def __setstate__(self, state):
+        # snapshot/restore: DHB drops the (unpicklable) provider closure
+        self.__dict__.update(state)
+        self._install_provider()
+
+    @classmethod
+    def builder(cls, dhb) -> "QueueingHoneyBadgerBuilder":
+        return QueueingHoneyBadgerBuilder(dhb)
 
     # -- ConsensusProtocol ---------------------------------------------------
 

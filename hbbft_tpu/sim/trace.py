@@ -1,0 +1,106 @@
+"""Structured observability for the simulators (SURVEY §5).
+
+The reference's only tracing is ``log`` crate debug lines plus the
+simulated-hardware timing table of ``examples/simulation.rs``.  This module
+provides both, structured:
+
+- :class:`EventLog` — one record per crank (sender, destination, message
+  type, wire size, outputs and faults produced), queryable and summable;
+- :class:`CostModel` — the reference example's synthetic hardware knobs
+  (per-message CPU lag + size/bandwidth charge) driving a virtual clock, so
+  throughput numbers mean something without real networking.
+
+``VirtualNet`` takes both as optional constructor arguments; the batched
+simulator reports its per-epoch dense counters through the detail dict it
+already returns.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, Hashable, List, Optional, Tuple
+
+logger = logging.getLogger("hbbft_tpu.sim")
+
+
+@dataclass
+class CrankEvent:
+    crank: int
+    sender: Hashable
+    dest: Hashable
+    msg_type: str
+    wire_bytes: int
+    outputs: int
+    faults: int
+    virtual_time: float
+
+
+@dataclass
+class EventLog:
+    """Append-only per-crank event records with summary accessors."""
+
+    events: List[CrankEvent] = field(default_factory=list)
+
+    def record(self, ev: CrankEvent) -> None:
+        self.events.append(ev)
+        logger.debug(
+            "crank %d: %s→%s %s (%dB) outputs=%d faults=%d t=%.6f",
+            ev.crank, ev.sender, ev.dest, ev.msg_type, ev.wire_bytes,
+            ev.outputs, ev.faults, ev.virtual_time,
+        )
+
+    def messages_by_type(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for ev in self.events:
+            out[ev.msg_type] = out.get(ev.msg_type, 0) + 1
+        return out
+
+    def bytes_by_type(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for ev in self.events:
+            out[ev.msg_type] = out.get(ev.msg_type, 0) + ev.wire_bytes
+        return out
+
+    def total_bytes(self) -> int:
+        return sum(ev.wire_bytes for ev in self.events)
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+
+@dataclass
+class CostModel:
+    """Reference ``examples/simulation.rs`` hardware model: delivering one
+    message costs ``cpu_lag_s`` plus ``wire_bytes / bandwidth_bps``."""
+
+    bandwidth_bps: float = 1e9
+    cpu_lag_s: float = 1e-5
+
+    def charge(self, wire_bytes: int) -> float:
+        return self.cpu_lag_s + 8.0 * wire_bytes / self.bandwidth_bps
+
+
+def wire_size(payload: Any) -> int:
+    """Canonical wire size of a protocol message (0 if not encodable)."""
+    import struct
+
+    from hbbft_tpu.protocols import wire
+
+    try:
+        return len(wire.encode_message(payload))
+    except (TypeError, ValueError, struct.error):
+        return 0
+
+
+def msg_type_path(payload: Any) -> str:
+    """Type path through nested wrappers, e.g.
+    ``HbWrap/SubsetWrap/BroadcastWrap/EchoMsg`` — the outermost name alone
+    would put every DHB message in one uninformative bucket."""
+    parts = []
+    seen = 0
+    while payload is not None and seen < 8:
+        parts.append(type(payload).__name__)
+        payload = getattr(payload, "msg", None)
+        seen += 1
+    return "/".join(parts)

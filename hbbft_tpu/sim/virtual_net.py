@@ -50,6 +50,8 @@ class VirtualNet:
         adversary: Optional[Adversary] = None,
         message_limit: Optional[int] = None,
         crank_limit: Optional[int] = None,
+        trace: Optional["EventLog"] = None,
+        cost_model: Optional["CostModel"] = None,
     ):
         self.nodes = nodes
         self.queue: List[NetworkMessage] = []
@@ -58,6 +60,9 @@ class VirtualNet:
         self.crank_limit = crank_limit
         self.messages_delivered = 0
         self.cranks = 0
+        self.trace = trace
+        self.cost_model = cost_model
+        self.virtual_time = 0.0
 
     # -- topology -----------------------------------------------------------
 
@@ -90,6 +95,25 @@ class VirtualNet:
         step = dest.algorithm.handle_message(msg.sender, msg.payload)
         self._process_step(dest, step)
         self.messages_delivered += 1
+        if self.trace is not None or self.cost_model is not None:
+            from hbbft_tpu.sim.trace import (
+                CrankEvent, msg_type_path, wire_size,
+            )
+
+            nbytes = wire_size(msg.payload)
+            if self.cost_model is not None:
+                self.virtual_time += self.cost_model.charge(nbytes)
+            if self.trace is not None:
+                self.trace.record(CrankEvent(
+                    crank=self.cranks,
+                    sender=msg.sender,
+                    dest=msg.to,
+                    msg_type=msg_type_path(msg.payload),
+                    wire_bytes=nbytes,
+                    outputs=len(step.output),
+                    faults=len(step.fault_log),
+                    virtual_time=self.virtual_time,
+                ))
         if (
             self.message_limit is not None
             and self.messages_delivered > self.message_limit
@@ -142,6 +166,8 @@ class NetBuilder:
         self._adversary: Optional[Adversary] = None
         self._message_limit: Optional[int] = None
         self._crank_limit: Optional[int] = None
+        self._trace = None
+        self._cost_model = None
 
     def faulty(self, ids: Sequence[NodeId]) -> "NetBuilder":
         self._faulty = set(ids)
@@ -164,6 +190,16 @@ class NetBuilder:
         self._crank_limit = n
         return self
 
+    def trace(self, log) -> "NetBuilder":
+        """Attach an :class:`hbbft_tpu.sim.trace.EventLog`."""
+        self._trace = log
+        return self
+
+    def cost_model(self, model) -> "NetBuilder":
+        """Attach an :class:`hbbft_tpu.sim.trace.CostModel` (virtual clock)."""
+        self._cost_model = model
+        return self
+
     def using_step(self, make_algo: Callable[[NodeId], Any]) -> VirtualNet:
         nodes = {
             nid: Node(
@@ -178,4 +214,6 @@ class NetBuilder:
             adversary=self._adversary,
             message_limit=self._message_limit,
             crank_limit=self._crank_limit,
+            trace=self._trace,
+            cost_model=self._cost_model,
         )

@@ -1,0 +1,48 @@
+"""Checkpoint / resume (SURVEY §5).
+
+The reference has no crash-restart persistence; its closest analogs are
+``JoinPlan`` (era-boundary join state, mirrored in
+``protocols/dynamic_honey_badger.py``) and the fact that every algorithm is
+a serializable value.  This module makes that explicit for both execution
+modes:
+
+- object mode: any ``ConsensusProtocol`` is a pure-Python state machine, so
+  ``snapshot``/``restore`` pickle it whole (the sans-I/O design means no
+  sockets/threads/fds can leak into the image).  Snapshots taken at the
+  same crank are byte-identical — a determinism check in itself.
+- batched mode: the dense state dicts of :mod:`hbbft_tpu.parallel` are
+  plain arrays; ``save_arrays``/``load_arrays`` round-trip them through an
+  ``.npz`` — the "per-epoch dense-state snapshot" the survey names as a
+  TPU-side win (snapshotting a whole network's epoch is one array dump).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Dict
+
+import numpy as np
+
+
+def snapshot(algorithm: Any) -> bytes:
+    """Serialize a protocol state machine (HoneyBadger, DHB, QHB, …)."""
+    return pickle.dumps(algorithm, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def restore(data: bytes) -> Any:
+    """Inverse of :func:`snapshot` — returns a live state machine that
+    continues exactly where the original stood."""
+    return pickle.loads(data)
+
+
+def save_arrays(state: Dict[str, Any]) -> bytes:
+    """Batched-mode state dict (str → array / scalar) → npz bytes."""
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in state.items()})
+    return buf.getvalue()
+
+
+def load_arrays(data: bytes) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(data)) as z:
+        return {k: z[k] for k in z.files}
