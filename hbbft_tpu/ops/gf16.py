@@ -1,0 +1,171 @@
+"""GF(2^16) arithmetic — the erasure field for N > 256 networks.
+
+The reference's ``reed-solomon-erasure`` crate (and our GF(2^8) coder in
+:mod:`hbbft_tpu.ops.gf256`) caps total shards at 256, i.e. N ≤ 256 nodes.
+BASELINE configs 4–5 ask for N = 1024 / 4096, so large networks switch to
+GF(2^16) (poly x¹⁶+x¹²+x³+x+1 = 0x1100B, generator 2): up to 65536 shards.
+
+Same design as gf256: host log/exp tables for construction/inversion, and
+the bit-plane lowering for device encode — a constant GF(2^16) matrix is
+GF(2)-linear, so applying it is one int8 matmul on (16·k → 16·r) bit
+vectors (symbols are u16, stored as little-endian byte pairs in shards).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+GF16_POLY = 0x1100B
+GF16_GEN = 2
+ORDER = 1 << 16
+
+
+def _build_tables():
+    exp = np.zeros(2 * ORDER, dtype=np.uint32)
+    log = np.zeros(ORDER, dtype=np.int64)
+    x = 1
+    for i in range(ORDER - 1):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & ORDER:
+            x ^= GF16_POLY
+    for i in range(ORDER - 1, 2 * ORDER):
+        exp[i] = exp[i - (ORDER - 1)]
+    return exp, log
+
+
+GF_EXP, GF_LOG = _build_tables()
+
+
+def gf_mul(a, b):
+    """Elementwise GF(2^16) multiply (numpy uint16-compatible arrays)."""
+    a = np.asarray(a, dtype=np.uint32)
+    b = np.asarray(b, dtype=np.uint32)
+    r = GF_EXP[(GF_LOG[a] + GF_LOG[b]) % (ORDER - 1)]
+    return np.where((a != 0) & (b != 0), r, 0).astype(np.uint16)
+
+
+def gf_inv(a):
+    a = np.asarray(a)
+    if np.any(a == 0):
+        raise ZeroDivisionError("GF(2^16) inverse of 0")
+    return GF_EXP[(ORDER - 1) - GF_LOG[a]].astype(np.uint16)
+
+
+def gf_pow(a: int, n: int) -> int:
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(GF_EXP[(int(GF_LOG[a]) * n) % (ORDER - 1)])
+
+
+def gf_matmul_np(A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    """GF(2^16) matrix product. A: (r, k), B: (k, c) → (r, c)."""
+    A = np.asarray(A, dtype=np.uint16)
+    B = np.asarray(B, dtype=np.uint16)
+    r, k = A.shape
+    k2, c = B.shape
+    assert k == k2
+    out = np.zeros((r, c), dtype=np.uint16)
+    for i in range(k):
+        out ^= gf_mul(A[:, i][:, None], B[i][None, :])
+    return out
+
+
+def gf_inv_matrix_np(M: np.ndarray) -> np.ndarray:
+    """Gauss–Jordan inversion over GF(2^16) (host)."""
+    M = np.asarray(M, dtype=np.uint16)
+    n = M.shape[0]
+    aug = np.concatenate([M.copy(), np.eye(n, dtype=np.uint16)], axis=1)
+    for col in range(n):
+        piv = col + int(np.argmax(aug[col:, col] != 0))
+        if aug[piv, col] == 0:
+            raise np.linalg.LinAlgError("singular GF(2^16) matrix")
+        if piv != col:
+            aug[[col, piv]] = aug[[piv, col]]
+        aug[col] = gf_mul(aug[col], gf_inv(aug[col, col]))
+        mask = aug[:, col].copy()
+        mask[col] = 0
+        aug ^= gf_mul(mask[:, None], aug[col][None, :])
+    return aug[:, n:].copy()
+
+
+def vandermonde(rows: int, cols: int) -> np.ndarray:
+    """V[r, c] = r^c — vectorized (the python-loop version took minutes at
+    the N=4096 network shape)."""
+    r = np.arange(rows, dtype=np.int64)
+    c = np.arange(cols, dtype=np.int64)
+    expnt = (GF_LOG[r][:, None] * c[None, :]) % (ORDER - 1)
+    V = GF_EXP[expnt].astype(np.uint16)
+    V[0, :] = 0  # 0^c = 0 …
+    V[:, 0] = 1  # … except c = 0: r^0 = 1 (including 0^0 per the coder)
+    return V
+
+
+def gf_matrix_to_bits(M: np.ndarray) -> np.ndarray:
+    """(r, k) GF(2^16) matrix → (k·16, r·16) GF(2) bit matrix (int8).
+
+    Layout mirrors gf256: ``A[k·16+i, j·16+b]`` = bit b of
+    ``gf_mul(M[j, k], 1 << i)``, bits LSB-first, so ``(bits(D) @ A) & 1``
+    applies M to symbol vectors D.
+    """
+    M = np.asarray(M, dtype=np.uint16)
+    r, k = M.shape
+    powers = (1 << np.arange(16)).astype(np.uint32)
+    prod = gf_mul(M[:, :, None], powers[None, None, :])  # (r, k, 16)
+    bits = (prod[..., None].astype(np.uint32) >> np.arange(16)) & 1
+    A = bits.transpose(1, 2, 0, 3).reshape(k * 16, r * 16)
+    return A.astype(np.int8)
+
+
+# device helpers -------------------------------------------------------------
+
+
+def bytes_to_symbol_bits(x):
+    """uint8 (..., k, B) shards → int8 bits (..., B//2, k*16).
+
+    Symbols are u16 from little-endian byte pairs along the shard; B must be
+    even.  Output layout matches :func:`gf_matrix_to_bits`.
+    """
+    import jax.numpy as jnp
+
+    *lead, k, B = x.shape
+    sym = x.reshape(*lead, k, B // 2, 2)
+    lo = sym[..., 0]
+    hi = sym[..., 1]
+    bits_lo = (lo[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+    bits_hi = (hi[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+    bits = jnp.concatenate([bits_lo, bits_hi], axis=-1)  # (..., k, B/2, 16)
+    bits = jnp.swapaxes(bits, -3, -2)  # (..., B/2, k, 16)
+    return bits.reshape(*lead, B // 2, k * 16).astype(jnp.int8)
+
+
+def symbol_bits_to_bytes(bits, r: int):
+    """int (..., B//2, r*16) bits → uint8 (..., r, B)."""
+    import jax.numpy as jnp
+
+    *lead, half, _ = bits.shape
+    b = bits.reshape(*lead, half, r, 16).astype(jnp.uint8)
+    w8 = jnp.left_shift(jnp.uint8(1), jnp.arange(8, dtype=jnp.uint8))
+    lo = (b[..., :8] * w8).sum(axis=-1).astype(jnp.uint8)
+    hi = (b[..., 8:] * w8).sum(axis=-1).astype(jnp.uint8)
+    sym = jnp.stack([lo, hi], axis=-1)  # (..., B/2, r, 2)
+    sym = jnp.swapaxes(sym, -3, -2)  # (..., r, B/2, 2)
+    return sym.reshape(*lead, r, half * 2)
+
+
+def gf_apply_bitmatrix(data, bitmat):
+    """Apply a constant GF(2^16) matrix to shard bytes on device.
+
+    data: uint8 (..., k, B) with even B; bitmat from
+    :func:`gf_matrix_to_bits` of shape (k*16, r*16).
+    Returns uint8 (..., r, B).
+    """
+    import jax.numpy as jnp
+
+    dbits = bytes_to_symbol_bits(data)
+    obits = jnp.matmul(dbits, bitmat, preferred_element_type=jnp.int32) & 1
+    r = bitmat.shape[1] // 16
+    return symbol_bits_to_bytes(obits, r)

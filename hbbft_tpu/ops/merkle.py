@@ -172,6 +172,31 @@ def merkle_build_jax(leaves):
     return root, proof, mask
 
 
+def merkle_root_jax(leaves):
+    """Root only — no proof/mask materialization.
+
+    leaves: uint8 (..., n, leaf_bytes) → (..., 32).  At N = 4096 the full
+    proof tensor of :func:`merkle_build_jax` is (P, n, 12, 32) ≈ gigabytes;
+    root checks (the batched simulator's re-encode verification) only need
+    this."""
+    import jax.numpy as jnp
+
+    from hbbft_tpu.ops.keccak import sha3_256
+
+    level = sha3_256(leaves)  # (..., n, 32)
+    width = leaves.shape[-2]
+    while width > 1:
+        pairs = width // 2
+        left = level[..., 0 : 2 * pairs : 2, :]
+        right = level[..., 1 : 2 * pairs : 2, :]
+        parents = sha3_256(jnp.concatenate([left, right], axis=-1))
+        if width % 2 == 1:
+            parents = jnp.concatenate([parents, level[..., -1:, :]], axis=-2)
+        level = parents
+        width = (width + 1) // 2
+    return level[..., 0, :]
+
+
 def merkle_verify_jax(values, indices, roots, proofs, mask):
     """Batched proof verification.
 

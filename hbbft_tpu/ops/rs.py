@@ -137,7 +137,92 @@ class ReedSolomon:
         return jnp.swapaxes(data, -1, -2)
 
 
+class ReedSolomon16:
+    """Systematic Vandermonde RS over GF(2^16) — for N > 256 networks.
+
+    Same construction as :class:`ReedSolomon` in the 65536-element field
+    (shard symbols are u16 little-endian byte pairs; shard length must be
+    even).  Exposes the subset of the API the batched large-N simulator
+    uses: host encode, device encode, host reconstruct.
+    """
+
+    def __init__(self, data_shards: int, parity_shards: int):
+        from hbbft_tpu.ops import gf16
+
+        if data_shards < 1:
+            raise ValueError("data_shards must be >= 1")
+        if data_shards + parity_shards > (1 << 16):
+            raise ValueError("total shards must be <= 65536 over GF(2^16)")
+        self.gf = gf16
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        V = gf16.vandermonde(self.total_shards, data_shards)
+        top_inv = gf16.gf_inv_matrix_np(V[:data_shards])
+        self.matrix = gf16.gf_matmul_np(V, top_inv)
+        assert np.array_equal(
+            self.matrix[:data_shards],
+            np.eye(data_shards, dtype=np.uint16),
+        )
+        self.parity_matrix = self.matrix[data_shards:]
+        self._parity_bits = gf16.gf_matrix_to_bits(self.parity_matrix)
+        self._decode_cache = {}
+
+    def _to_symbols(self, shards: np.ndarray) -> np.ndarray:
+        k, B = shards.shape[-2:]
+        assert B % 2 == 0, "GF(2^16) shards need even byte length"
+        s = shards.reshape(*shards.shape[:-1], B // 2, 2).astype(np.uint16)
+        return s[..., 0] | (s[..., 1] << 8)
+
+    def _from_symbols(self, sym: np.ndarray) -> np.ndarray:
+        lo = (sym & 0xFF).astype(np.uint8)
+        hi = (sym >> 8).astype(np.uint8)
+        return np.stack([lo, hi], axis=-1).reshape(
+            *sym.shape[:-1], sym.shape[-1] * 2
+        )
+
+    def encode_np(self, data: np.ndarray) -> np.ndarray:
+        data = np.asarray(data, dtype=np.uint8)
+        assert data.shape[0] == self.data_shards
+        if self.parity_shards == 0:
+            return data.copy()
+        D = self._to_symbols(data)
+        parity = self.gf.gf_matmul_np(self.parity_matrix, D)
+        return np.concatenate([data, self._from_symbols(parity)], axis=0)
+
+    def encode_jax(self, data):
+        """uint8 (..., data_shards, B) → (..., total_shards, B), B even."""
+        import jax.numpy as jnp
+
+        if self.parity_shards == 0:
+            return data
+        parity = self.gf.gf_apply_bitmatrix(
+            data, jnp.asarray(self._parity_bits)
+        )
+        return jnp.concatenate([data, parity], axis=-2)
+
+    def decode_matrix(self, use: Tuple[int, ...]) -> np.ndarray:
+        if use not in self._decode_cache:
+            sub = self.matrix[list(use)]
+            self._decode_cache[use] = self.gf.gf_inv_matrix_np(sub)
+        return self._decode_cache[use]
+
+    def reconstruct_data_np(
+        self, survivors: np.ndarray, use: Tuple[int, ...]
+    ) -> np.ndarray:
+        """(data, B) data shards from the survivor rows ``use``."""
+        dec = self.decode_matrix(tuple(use))
+        S = self._to_symbols(np.asarray(survivors, dtype=np.uint8))
+        return self._from_symbols(self.gf.gf_matmul_np(dec, S))
+
+
 @functools.lru_cache(maxsize=256)
-def for_n_f(n: int, f: int) -> ReedSolomon:
-    """The RBC coder for an (n, f) network: data = n−2f, parity = 2f."""
-    return ReedSolomon(n - 2 * f, 2 * f)
+def for_n_f(n: int, f: int):
+    """The RBC coder for an (n, f) network: data = n−2f, parity = 2f.
+
+    GF(2^8) (bit-exact with the reference's crate) up to 256 shards; the
+    GF(2^16) coder beyond — the reference cannot represent such networks
+    at all (its erasure field caps shards at 256)."""
+    if n <= 256:
+        return ReedSolomon(n - 2 * f, 2 * f)
+    return ReedSolomon16(n - 2 * f, 2 * f)

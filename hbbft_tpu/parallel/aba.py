@@ -78,6 +78,12 @@ class BatchedAba:
         decision = state["decision"]
         P = est.shape[1]
 
+        if bval_mask is None and aux_mask is None and conf_mask is None:
+            # full-delivery fast path: counts are receiver-independent, so
+            # nothing of shape (N, N, P) is materialized — O(N·P) per epoch,
+            # which is what makes N ≥ 1024 instances × nodes feasible
+            return self._epoch_step_full_delivery(state, coin_bits)
+
         if bval_mask is None:
             bval_mask = jnp.ones((n, n, P), dtype=bool)
         if aux_mask is None:
@@ -182,6 +188,78 @@ class BatchedAba:
         for v in (False, True):
             term_cnt = (decided & (decision == v)).sum(axis=0)  # (P,)
             adopt = active & (term_cnt >= (f + 1))[None, :] & ~decided
+            decision = jnp.where(adopt, v, decision)
+            decided = decided | adopt
+
+        return {
+            "est": est,
+            "decided": decided,
+            "decision": decision,
+            "epoch": state["epoch"] + 1,
+        }
+
+    def _epoch_step_full_delivery(self, state, coin_bits):
+        """Masks-free epoch: every count is the same at every receiver."""
+        import jax
+        import jax.numpy as jnp
+
+        n, f = self.n, self.f
+        est = state["est"]
+        decided = state["decided"]
+        decision = state["decision"]
+        P = est.shape[1]
+
+        active = ~decided
+        val_axis = jnp.stack([~est, est], axis=-1)
+        term_axis = jnp.stack([~decision, decision], axis=-1)
+        sent = jnp.where(decided[..., None], term_axis, val_axis)  # (N,P,2)
+
+        def relay(_, s):
+            cnt = s.sum(axis=0)  # (P, 2) — identical at every receiver
+            return s | (cnt >= (f + 1))[None]
+
+        # with full delivery one relay round reaches the fixpoint (every
+        # f+1-supported value is re-broadcast by everyone at once); a second
+        # covers the cascade where the relay itself creates new f+1 support
+        sent = jax.lax.fori_loop(0, 2, relay, sent)
+        cnt = sent.sum(axis=0)
+        bin_vals = cnt >= (2 * f + 1)  # (P, 2), shared
+
+        aux_val = jnp.where(decided, decision, bin_vals[None, :, 1])
+        aux_sent = bin_vals.any(axis=-1)[None] | decided
+        aux_v = jnp.stack([~aux_val, aux_val], axis=-1) & aux_sent[..., None]
+        support = (aux_v & bin_vals[None]).any(axis=-1).sum(axis=0)  # (P,)
+        vals = bin_vals & (aux_v.sum(axis=0) > 0)  # (P, 2), shared
+        sbv_done = support >= (n - f)  # (P,)
+
+        conf = jnp.where(decided[..., None], term_axis, vals[None])
+        viol = (conf & ~bin_vals[None]).any(axis=-1)  # (N, P)
+        sent_conf = sbv_done[None] | decided
+        conf_count = (sent_conf & ~viol).sum(axis=0)  # (P,)
+        conf_done = conf_count >= (n - f)
+
+        m = state["epoch"] % 3
+        coin = jnp.where(
+            m == 0,
+            jnp.ones((P,), dtype=bool),
+            jnp.where(m == 1, jnp.zeros((P,), dtype=bool), coin_bits),
+        )
+
+        only_true = vals[:, 1] & ~vals[:, 0]
+        only_false = vals[:, 0] & ~vals[:, 1]
+        vals_single = only_true | only_false
+        vals_val = only_true
+        ready = (conf_done & sbv_done)[None] & active
+        decide_now = ready & (vals_single & (vals_val == coin))[None]
+        new_est = jnp.where(vals_single, vals_val, coin)[None]
+        est = jnp.where(ready, jnp.broadcast_to(new_est, est.shape), est)
+        coin_b = jnp.broadcast_to(coin[None], est.shape)
+        decision = jnp.where(decide_now, coin_b, decision)
+        decided = decided | decide_now
+
+        for v in (False, True):
+            term_cnt = (decided & (decision == v)).sum(axis=0)
+            adopt = active & (term_cnt >= (f + 1))[None] & ~decided
             decision = jnp.where(adopt, v, decision)
             decided = decided | adopt
 

@@ -39,7 +39,11 @@ class BatchedAcs:
         # recompile the whole pipeline every epoch
         import jax
 
-        self._rbc_run = jax.jit(self.rbc.run)
+        # the large-N RBC path orchestrates host steps internally and must
+        # not be wrapped in jit
+        self._rbc_run = (
+            self.rbc.run if self.rbc.large else jax.jit(self.rbc.run)
+        )
         self._aba_step = jax.jit(self.aba.epoch_step)
 
     def run(
@@ -91,6 +95,7 @@ class BatchedAcs:
             "accepted": np.asarray(st["decision"]),
             "delivered": np.asarray(delivered),
             "data": np.asarray(out["data"]),
+            "data_receivers": np.asarray(out["data_receivers"]),
             "rbc_fault": np.asarray(out["fault"]),
             "epochs": epochs,
         }
@@ -150,6 +155,9 @@ class BatchedHoneyBadgerEpoch:
         row = accepted[0]
         batch: Dict = {}
         t = pks.threshold()
+        # map delivering receivers to rows in the data array once
+        # (the full-delivery fast path returns one shared row)
+        row_of = {int(r): i for i, r in enumerate(out["data_receivers"])}
         for p, nid in enumerate(self.ids):
             if not row[p]:
                 continue
@@ -158,7 +166,12 @@ class BatchedHoneyBadgerEpoch:
                 raise RuntimeError(
                     f"instance {p} accepted but no node delivered its value"
                 )
-            payload = unframe_value(out["data"][int(deliverers[0]), p])
+            rows = [row_of[int(d)] for d in deliverers if int(d) in row_of]
+            if not rows:
+                raise RuntimeError(
+                    f"instance {p}: no delivering receiver has a data row"
+                )
+            payload = unframe_value(out["data"][rows[0], p])
             if payload is None:
                 continue
             if encrypt:

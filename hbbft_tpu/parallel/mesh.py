@@ -81,6 +81,7 @@ def sharded_rbc_run(rbc: BatchedRbc, mesh, data, codeword_tamper=None,
         "delivered": spec_p,
         "fault": spec_p,
         "data": spec_p,
+        "data_receivers": spec_p,
         "root": spec_r,
         "echo_count": spec_p,
         "ready_count": spec_p,

@@ -58,9 +58,10 @@ class BatchedRbc:
         self.f = f
         self.coder = rs_mod.for_n_f(n, f)
         self.k = self.coder.data_shards
-        # constant full-encode bit-matrix (k → n shards) for the re-encode
-        # check; identity-top (systematic) like the object path.
-        self._encode_bits = gf256.gf_matrix_to_bits(self.coder.matrix)
+        # N > 256 exceeds GF(2^8): the coder is GF(2^16) and only the
+        # full-delivery scale path is supported (see _run_large)
+        self.large = n > 256
+        self._jit_cache = {}
 
     # ---------------------------------------------------------------- phases
 
@@ -101,6 +102,13 @@ class BatchedRbc:
         where delivered), ``root`` (P, 32), ``echo_count`` (N, P),
         ``ready_count`` (N, P).
         """
+        if self.large:
+            if any(m is not None for m in (value_mask, echo_mask, ready_mask)):
+                raise NotImplementedError(
+                    "delivery masks are supported up to N=256; the large-N "
+                    "path is full-delivery only"
+                )
+            return self._run_large(data, codeword_tamper, value_tamper)
         shards, root, proofs, pmask = self.propose(data, codeword_tamper)
         sent = shards if value_tamper is None else shards ^ value_tamper
         return self.run_from_proposal(
@@ -129,6 +137,15 @@ class BatchedRbc:
 
         n, f, k = self.n, self.f, self.k
         P = sent.shape[0]
+
+        if (value_mask is None and echo_mask is None and ready_mask is None
+                and receivers is None):
+            # full-delivery fast path: every receiver sees the identical
+            # message set, so counting is O(N·P) and the heavy decode runs
+            # ONCE and is shared — this is what makes N ≥ 1024 feasible
+            # (the masked path materializes (receiver, sender, instance)
+            # tensors and per-receiver decodes: O(N³) / O(N²·k·B)).
+            return self._run_full_delivery(sent, root, proofs, pmask)
 
         if value_mask is None:
             value_mask = jnp.ones((P, n), dtype=bool)
@@ -249,9 +266,175 @@ class BatchedRbc:
             "delivered": delivered,
             "fault": fault,
             "data": data_rec,
+            "data_receivers": receivers,
             "root": root,
             "echo_count": echo_count,
             "ready_count": ready_count,
+        }
+
+    def _run_full_delivery(self, sent, root, proofs, pmask):
+        """All messages delivered: every receiver's state is identical, so
+        verdicts are computed once and broadcast.  ``data`` has a single
+        shared row (``data_receivers == [0]``)."""
+        import jax.numpy as jnp
+
+        n, f, k = self.n, self.f, self.k
+        P = sent.shape[0]
+
+        idx = jnp.broadcast_to(jnp.arange(n)[None, :], (P, n))
+        vv = merkle_verify_jax(
+            sent, idx, root[:, None, :], proofs, pmask[None, :, :]
+        )  # (P, n): source i's Value/Echo is valid
+        ec = vv.sum(axis=1)  # (P,) — every receiver counts the same echoes
+        ready = ec >= (n - f)
+        rc = jnp.where(ready, n, 0)  # all n send Ready together
+        can_decode = (rc >= (2 * f + 1)) & (ec >= k)
+
+        # shared decode: first-k surviving shards (same pattern everywhere)
+        order = jnp.argsort(~vv, axis=-1, stable=True)
+        use = order[..., :k]  # (P, k)
+        surv_ok = jnp.take_along_axis(vv, use, axis=-1).all(axis=-1)
+        surv = jnp.take_along_axis(sent, use[..., None], axis=-2)  # (P,k,B)
+        enc = jnp.asarray(self.coder.matrix)
+        sub = enc[use]  # (P, k, k)
+        dec, inv_ok = gf256.gf_inv_matrix_jnp(sub)
+        dec_bits = gf256.gf_matrix_to_bits_jnp(dec)
+        data_rec = jnp.swapaxes(
+            gf256.gf_apply_bitmatrix(jnp.swapaxes(surv, -1, -2), dec_bits),
+            -1, -2,
+        )  # (P, k, B)
+
+        full = self.coder.encode_jax(data_rec)  # (P, n, B)
+        full_obj = jnp.where(vv[..., None], sent, full)
+        root_chk, _, _ = merkle_build_jax(full_obj)
+        root_ok = jnp.all(root_chk == root, axis=-1)
+        data_rec = full_obj[..., :k, :]
+
+        B = sent.shape[-1]
+        flat = data_rec.reshape(P, k * B)
+        if k * B >= 4:
+            ln = (
+                flat[..., 0].astype(jnp.uint32) << 24
+                | flat[..., 1].astype(jnp.uint32) << 16
+                | flat[..., 2].astype(jnp.uint32) << 8
+                | flat[..., 3].astype(jnp.uint32)
+            )
+            frame_ok = ln <= jnp.uint32(k * B - 4)
+        else:
+            frame_ok = jnp.zeros((P,), dtype=bool)
+
+        ok = can_decode & surv_ok & inv_ok
+        delivered = ok & root_ok & frame_ok  # (P,)
+        fault = ok & ~(root_ok & frame_ok)
+        bc = lambda a: jnp.broadcast_to(a[None, :], (n, P))
+        return {
+            "delivered": bc(delivered),
+            "fault": bc(fault),
+            "data": data_rec[None],  # (1, P, k, B) — shared row
+            "data_receivers": jnp.zeros((1,), dtype=jnp.int32),
+            "root": root,
+            "echo_count": bc(ec),
+            "ready_count": bc(rc),
+        }
+
+
+    # ------------------------------------------------------------- large N
+    def _jit(self, name, fn):
+        if name not in self._jit_cache:
+            import jax
+
+            self._jit_cache[name] = jax.jit(fn)
+        return self._jit_cache[name]
+
+    def _run_large(self, data, codeword_tamper=None, value_tamper=None):
+        """Full-delivery RBC round for N > 256 (GF(2^16) coder).
+
+        Two jitted stages with a host decision between them:
+
+        1. encode + root-only Merkle commit; echo validity as a direct
+           comparison of the received shard against the commitment (the
+           simulator's god-view equivalent of per-proof verification —
+           a proof verifies iff the shard matches what was committed);
+        2. reconstruct (identity decode where the data rows survived —
+           the overwhelmingly common case; host GF(2^16) decode for the
+           stragglers), re-encode, root re-check, framing check.
+        """
+        import jax.numpy as jnp
+
+        from hbbft_tpu.ops.merkle import merkle_root_jax
+
+        n, f, k = self.n, self.f, self.k
+        P = data.shape[0]
+
+        def stage_a(d, cw, vt):
+            shards = self.coder.encode_jax(d)
+            if cw is not None:
+                shards = shards ^ cw
+            root = merkle_root_jax(shards)
+            sent = shards if vt is None else shards ^ vt
+            vv = jnp.all(sent == shards, axis=-1)  # (P, n) god-view verify
+            return shards, sent, root, vv
+
+        key = ("A", codeword_tamper is not None, value_tamper is not None)
+        shards, sent, root, vv = self._jit(key, stage_a)(
+            data, codeword_tamper, value_tamper
+        )
+        vv_h = np.asarray(vv)
+        ec = vv_h.sum(axis=1)  # (P,)
+        ready = ec >= (n - f)
+        can_decode = ready & (ec >= k)
+
+        # decode: identity where the first k shards are intact; host GF(2^16)
+        # reconstruct otherwise
+        ident = vv_h[:, :k].all(axis=1)
+        if bool(ident.all()):
+            data_rec = sent[:, :k, :]
+        else:
+            sent_h = np.asarray(sent)
+            rows = []
+            for p in range(P):
+                if ident[p] or not can_decode[p]:
+                    rows.append(sent_h[p, :k])
+                    continue
+                use = tuple(np.flatnonzero(vv_h[p])[:k].tolist())
+                rows.append(
+                    self.coder.reconstruct_data_np(sent_h[p, list(use)], use)
+                )
+            data_rec = jnp.asarray(np.stack(rows))
+
+        def stage_b(dr, sent_, vv_, root_):
+            full = self.coder.encode_jax(dr)
+            full_obj = jnp.where(vv_[..., None], sent_, full)
+            root_chk = merkle_root_jax(full_obj)
+            root_ok = jnp.all(root_chk == root_, axis=-1)
+            out_data = full_obj[..., :k, :]
+            B = out_data.shape[-1]
+            flat = out_data.reshape(out_data.shape[0], k * B)
+            ln = (
+                flat[..., 0].astype(jnp.uint32) << 24
+                | flat[..., 1].astype(jnp.uint32) << 16
+                | flat[..., 2].astype(jnp.uint32) << 8
+                | flat[..., 3].astype(jnp.uint32)
+            )
+            frame_ok = ln <= jnp.uint32(k * B - 4)
+            return out_data, root_ok, frame_ok
+
+        out_data, root_ok, frame_ok = self._jit("B", stage_b)(
+            data_rec, sent, vv, root
+        )
+        root_ok = np.asarray(root_ok)
+        frame_ok = np.asarray(frame_ok)
+        delivered = can_decode & root_ok & frame_ok
+        fault = can_decode & ~(root_ok & frame_ok)
+        bc = lambda a: np.broadcast_to(a[None, :], (n, P))
+        return {
+            "delivered": bc(delivered),
+            "fault": bc(fault),
+            "data": np.asarray(out_data)[None],  # (1, P, k, B) shared row
+            "data_receivers": np.zeros((1,), dtype=np.int32),
+            "root": np.asarray(root),
+            "echo_count": bc(ec),
+            "ready_count": bc(np.where(ready, n, 0)),
         }
 
 
@@ -261,8 +444,12 @@ class BatchedRbc:
 def frame_values(values, k: int) -> np.ndarray:
     """Frame a list of P byte-strings like the object-mode proposer does
     (4-byte length prefix, zero-padded) at one common shard length, so the
-    row-major byte stream stays contiguous: (P, k, B)."""
-    shard_len = max(1, max(-(-(4 + len(v)) // k) for v in values))
+    row-major byte stream stays contiguous: (P, k, B).
+
+    The shard length is rounded up to even so the same framing feeds both
+    the GF(2^8) and GF(2^16) (u16-symbol) coders."""
+    shard_len = max(2, max(-(-(4 + len(v)) // k) for v in values))
+    shard_len += shard_len % 2
     out = np.zeros((len(values), k, shard_len), dtype=np.uint8)
     for i, v in enumerate(values):
         stream = len(v).to_bytes(4, "big") + v

@@ -62,6 +62,10 @@ class VirtualNet:
         self.cranks = 0
         self.trace = trace
         self.cost_model = cost_model
+        # per-node clocks: nodes work in parallel, so simulated wall time is
+        # the max over nodes, not the sum (mirrors the reference example's
+        # per-node timing model)
+        self.node_times: Dict[NodeId, float] = {}
         self.virtual_time = 0.0
 
     # -- topology -----------------------------------------------------------
@@ -102,7 +106,9 @@ class VirtualNet:
 
             nbytes = wire_size(msg.payload)
             if self.cost_model is not None:
-                self.virtual_time += self.cost_model.charge(nbytes)
+                t = self.node_times.get(msg.to, 0.0) + self.cost_model.charge(nbytes)
+                self.node_times[msg.to] = t
+                self.virtual_time = max(self.virtual_time, t)
             if self.trace is not None:
                 self.trace.record(CrankEvent(
                     crank=self.cranks,

@@ -128,3 +128,26 @@ def test_batched_hb_epoch_matches_object_mode(encrypt):
     ]
     assert all(len(b) == 1 for b in object_batches)
     assert batch_b == object_batches[0][0].contributions_map()
+
+
+def test_aba_fast_path_matches_masked_path():
+    """Maskless ABA epochs must evolve identically to all-ones-mask epochs."""
+    n, f, P = 7, 2, 6
+    aba = BatchedAba(n, f)
+    rng = np.random.default_rng(8)
+    st_f = aba.init_state(jnp.asarray(rng.random((n, P)) < 0.5))
+    st_m = {k: v for k, v in st_f.items()}
+    ones = jnp.ones((n, n, P), dtype=bool)
+    step = jax.jit(aba.epoch_step)
+    for e in range(6):
+        coins = jnp.asarray(rng.random(P) < 0.5)
+        st_f = step(st_f, coins)
+        st_m = step(st_m, coins, bval_mask=ones, aux_mask=ones,
+                    conf_mask=ones)
+        for k in ("est", "decided", "decision"):
+            np.testing.assert_array_equal(
+                np.asarray(st_f[k]), np.asarray(st_m[k]), err_msg=f"{k}@{e}"
+            )
+        if bool(np.asarray(st_f["decided"]).all()):
+            break
+    assert bool(np.asarray(st_f["decided"]).all())

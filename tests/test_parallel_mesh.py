@@ -29,7 +29,17 @@ def test_sharded_matches_single_device(mesh8):
     values = [bytes([p]) * (3 * p + 1) for p in range(n)]
     data = jnp.asarray(frame_values(values, rbc.k))
 
-    single = {k: np.asarray(v) for k, v in jax.jit(rbc.run)(data).items()}
+    # compare against the MASKED single-device path (explicit all-ones
+    # masks): the maskless call takes the shared-row fast path, whose
+    # result layout differs by design
+    ones_vm = jnp.ones((n, n), dtype=bool)
+    ones_em = jnp.ones((n, n, n), dtype=bool)
+    single = {
+        k: np.asarray(v)
+        for k, v in jax.jit(rbc.run)(
+            data, value_mask=ones_vm, echo_mask=ones_em, ready_mask=ones_em
+        ).items()
+    }
     sharded = {
         k: np.asarray(v) for k, v in sharded_rbc_run(rbc, mesh8, data).items()
     }

@@ -311,5 +311,34 @@ def test_value_tamper_invalid_proofs_not_delivered():
     # echo from node 0 missing (its proof failed) but n-1 ≥ n-f echoes remain
     assert (out["echo_count"][:, 0] == n - 1).all()
     assert out["delivered"].all()
-    for j in range(n):
-        assert unframe_value(out["data"][j, 0]) == values[0]
+    # no masks → full-delivery fast path: one shared data row for everyone
+    assert list(out["data_receivers"]) == [0]
+    assert unframe_value(out["data"][0, 0]) == values[0]
+
+
+def test_full_delivery_fast_path_matches_masked_path():
+    """The maskless fast path (shared decode) must agree with the explicit
+    all-ones-mask path on verdicts, counts, and values."""
+    n = 7
+    f = (n - 1) // 3
+    rng = random.Random(55)
+    values = [bytes(rng.randrange(256) for _ in range(9)) for _ in range(n)]
+    rbc = BatchedRbc(n, f)
+    data = jnp.asarray(frame_values(values, rbc.k))
+    fast = {k: np.asarray(v) for k, v in jax.jit(rbc.run)(data).items()}
+    vm, em, rm = all_masks(n, n)
+    slow = {
+        k: np.asarray(v)
+        for k, v in jax.jit(rbc.run)(
+            data,
+            value_mask=jnp.asarray(vm),
+            echo_mask=jnp.asarray(em),
+            ready_mask=jnp.asarray(rm),
+        ).items()
+    }
+    for key in ("delivered", "fault", "echo_count", "ready_count"):
+        np.testing.assert_array_equal(fast[key], slow[key], err_msg=key)
+    for p in range(n):
+        assert unframe_value(fast["data"][0, p]) == unframe_value(
+            slow["data"][0, p]
+        ) == values[p]
