@@ -147,6 +147,8 @@ class ReedSolomon16:
     """
 
     def __init__(self, data_shards: int, parity_shards: int):
+        import os
+
         from hbbft_tpu.ops import gf16
 
         if data_shards < 1:
@@ -157,9 +159,26 @@ class ReedSolomon16:
         self.data_shards = data_shards
         self.parity_shards = parity_shards
         self.total_shards = data_shards + parity_shards
-        V = gf16.vandermonde(self.total_shards, data_shards)
-        top_inv = gf16.gf_inv_matrix_np(V[:data_shards])
-        self.matrix = gf16.gf_matmul_np(V, top_inv)
+        # The systematic-matrix construction is O(total·data²) host table
+        # lookups — ~10 minutes at the N=4096 network shape — so it is
+        # cached on disk (the 4096-shard matrix is ~11 MB).
+        cache_dir = os.path.join(
+            os.path.expanduser("~"), ".cache", "hbbft_tpu"
+        )
+        cache = os.path.join(
+            cache_dir, f"rs16_{data_shards}_{parity_shards}.npz"
+        )
+        if os.path.exists(cache):
+            self.matrix = np.load(cache)["matrix"]
+        else:
+            V = gf16.vandermonde(self.total_shards, data_shards)
+            top_inv = gf16.gf_inv_matrix_np(V[:data_shards])
+            self.matrix = gf16.gf_matmul_np(V, top_inv)
+            try:
+                os.makedirs(cache_dir, exist_ok=True)
+                np.savez_compressed(cache, matrix=self.matrix)
+            except OSError:
+                pass
         assert np.array_equal(
             self.matrix[:data_shards],
             np.eye(data_shards, dtype=np.uint16),
