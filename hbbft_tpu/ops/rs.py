@@ -209,15 +209,20 @@ class ReedSolomon16:
         parity = self.gf.gf_matmul_np(self.parity_matrix, D)
         return np.concatenate([data, self._from_symbols(parity)], axis=0)
 
-    def encode_jax(self, data):
-        """uint8 (..., data_shards, B) → (..., total_shards, B), B even."""
+    def encode_jax(self, data, parity_bits=None):
+        """uint8 (..., data_shards, B) → (..., total_shards, B), B even.
+
+        ``parity_bits`` lets callers pass the (large — ~1 GB at the N=4096
+        shape) bit matrix as a traced ARGUMENT; capturing it as a jit
+        constant embeds it in the serialized HLO, which breaks the remote
+        compile transport in this environment."""
         import jax.numpy as jnp
 
         if self.parity_shards == 0:
             return data
-        parity = self.gf.gf_apply_bitmatrix(
-            data, jnp.asarray(self._parity_bits)
-        )
+        if parity_bits is None:
+            parity_bits = jnp.asarray(self._parity_bits)
+        parity = self.gf.gf_apply_bitmatrix(data, parity_bits)
         return jnp.concatenate([data, parity], axis=-2)
 
     def decode_matrix(self, use: Tuple[int, ...]) -> np.ndarray:

@@ -359,25 +359,49 @@ class BatchedRbc:
            the overwhelmingly common case; host GF(2^16) decode for the
            stragglers), re-encode, root re-check, framing check.
         """
+        import jax
         import jax.numpy as jnp
 
         from hbbft_tpu.ops.merkle import merkle_root_jax
 
         n, f, k = self.n, self.f, self.k
         P = data.shape[0]
+        # chunk the proposer axis: bounds the keccak working set (P·n Merkle
+        # leaves at once is gigabytes at N=4096).  cs is shape-derived, so
+        # it must be part of the jit-cache key (a cached closure retraced
+        # with a stale cs would mis-reshape a different P).
+        cs = next(c for c in (64, 32, 16, 8, 4, 2, 1) if P % c == 0)
+        if not hasattr(self, "_pbits_dev"):
+            self._pbits_dev = jnp.asarray(self.coder._parity_bits)
 
-        def stage_a(d, cw, vt):
-            shards = self.coder.encode_jax(d)
-            if cw is not None:
-                shards = shards ^ cw
-            root = merkle_root_jax(shards)
-            sent = shards if vt is None else shards ^ vt
-            vv = jnp.all(sent == shards, axis=-1)  # (P, n) god-view verify
-            return shards, sent, root, vv
+        def chunked_map(fn, args):
+            """lax.map ``fn`` over proposer-axis chunks of ``args`` (None
+            members pass through unchunked as empty pytrees)."""
+            nch = P // cs
+            chunk = lambda a: (
+                None if a is None else a.reshape(nch, cs, *a.shape[1:])
+            )
+            outs = jax.lax.map(fn, tuple(chunk(a) for a in args))
+            unc = lambda a: a.reshape(a.shape[0] * a.shape[1], *a.shape[2:])
+            return tuple(unc(o) for o in outs)
 
-        key = ("A", codeword_tamper is not None, value_tamper is not None)
+        def stage_a(d, cw, vt, pbits):
+            def one(args):
+                dc, cwc, vtc = args
+                shards = self.coder.encode_jax(dc, pbits)
+                if cwc is not None:
+                    shards = shards ^ cwc
+                root = merkle_root_jax(shards)
+                sent = shards if vtc is None else shards ^ vtc
+                vv = jnp.all(sent == shards, axis=-1)
+                return shards, sent, root, vv
+
+            return chunked_map(one, (d, cw, vt))
+
+        key = ("A", P, cs, codeword_tamper is not None,
+               value_tamper is not None)
         shards, sent, root, vv = self._jit(key, stage_a)(
-            data, codeword_tamper, value_tamper
+            data, codeword_tamper, value_tamper, self._pbits_dev
         )
         vv_h = np.asarray(vv)
         ec = vv_h.sum(axis=1)  # (P,)
@@ -402,25 +426,29 @@ class BatchedRbc:
                 )
             data_rec = jnp.asarray(np.stack(rows))
 
-        def stage_b(dr, sent_, vv_, root_):
-            full = self.coder.encode_jax(dr)
-            full_obj = jnp.where(vv_[..., None], sent_, full)
-            root_chk = merkle_root_jax(full_obj)
-            root_ok = jnp.all(root_chk == root_, axis=-1)
-            out_data = full_obj[..., :k, :]
-            B = out_data.shape[-1]
-            flat = out_data.reshape(out_data.shape[0], k * B)
-            ln = (
-                flat[..., 0].astype(jnp.uint32) << 24
-                | flat[..., 1].astype(jnp.uint32) << 16
-                | flat[..., 2].astype(jnp.uint32) << 8
-                | flat[..., 3].astype(jnp.uint32)
-            )
-            frame_ok = ln <= jnp.uint32(k * B - 4)
-            return out_data, root_ok, frame_ok
+        def stage_b(dr, sent_, vv_, root_, pbits):
+            def one(args):
+                drc, sc, vc, rc = args
+                full = self.coder.encode_jax(drc, pbits)
+                full_obj = jnp.where(vc[..., None], sc, full)
+                root_chk = merkle_root_jax(full_obj)
+                root_ok = jnp.all(root_chk == rc, axis=-1)
+                out_data = full_obj[..., :k, :]
+                B = out_data.shape[-1]
+                flat = out_data.reshape(out_data.shape[0], k * B)
+                ln = (
+                    flat[..., 0].astype(jnp.uint32) << 24
+                    | flat[..., 1].astype(jnp.uint32) << 16
+                    | flat[..., 2].astype(jnp.uint32) << 8
+                    | flat[..., 3].astype(jnp.uint32)
+                )
+                frame_ok = ln <= jnp.uint32(k * B - 4)
+                return out_data, root_ok, frame_ok
 
-        out_data, root_ok, frame_ok = self._jit("B", stage_b)(
-            data_rec, sent, vv, root
+            return chunked_map(one, (dr, sent_, vv_, root_))
+
+        out_data, root_ok, frame_ok = self._jit(("B", P, cs), stage_b)(
+            data_rec, sent, vv, root, self._pbits_dev
         )
         root_ok = np.asarray(root_ok)
         frame_ok = np.asarray(frame_ok)
