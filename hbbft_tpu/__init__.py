@@ -18,15 +18,18 @@ stack of asynchronous BFT consensus state machines —
   membership via on-line DKG,
 - ``protocols.queueing_honey_badger`` — transaction queueing.
 
-The hot per-epoch math (GF(2^8) Reed–Solomon, keccak/Merkle) lives in
-``ops/`` as batched jnp kernels over arbitrary leading axes
-(node × instance × epoch); ``parallel/`` holds the dense-array
-bulk-synchronous simulator — currently the full RBC round over
-(proposer × receiver), single-device or ``shard_map``-sharded over a mesh —
-cross-checked against object mode; ``sim/`` holds the object-mode
-deterministic ``VirtualNet`` harness with adversaries (reference:
-``tests/net/``).  BLS/TPKE is host-side (``crypto/``) pending the on-device
-limbed-field backend.
+The hot per-epoch math lives in ``ops/`` as batched jnp kernels over
+arbitrary leading axes (node × instance × epoch): GF(2^8) and GF(2^16)
+Reed–Solomon, keccak/Merkle, and limbed BLS12-381 field/curve arithmetic.
+``parallel/`` holds the dense-array bulk-synchronous simulator — batched
+RBC rounds, ABA epochs, their ACS composition, and the full HoneyBadger
+epoch — cross-checked against object mode, single-device or
+``shard_map``-sharded over a mesh, scaling to N=4096 nodes on one chip.
+``sim/`` holds the object-mode deterministic ``VirtualNet`` harness with
+adversaries, tracing, and a cost model (reference: ``tests/net/``).
+``crypto/`` is the host BLS/TPKE (``threshold_crypto``-shaped API) with a
+byte-parity-proven C++ fast path (``native/``) and device batch
+verification (``crypto/batch.py``).
 
 The reference is sans-I/O: every algorithm consumes inputs/messages and
 returns a ``Step``; the caller owns the event loop.  We keep that contract
