@@ -483,12 +483,7 @@ CONFIGS = {
     "coin256": bench_coin256,
 }
 
-# coin256 is excluded from "all": the device BLS ladder is correct but its
-# current XLA lowering is dispatch-bound (~4 min/verify at N=256 — slower
-# than the host path) and its first compile is ~8 min.  Run it explicitly
-# with --config coin256; making it win is open optimization work (stacked
-# formula batching / a Pallas field kernel).
-_DEFAULT_SET = [k for k in CONFIGS if k != "coin256"]
+_DEFAULT_SET = list(CONFIGS)
 
 
 def main(argv=None):
@@ -497,6 +492,10 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     import jax
+
+    from hbbft_tpu.util import enable_compilation_cache
+
+    enable_compilation_cache()
 
     device = jax.devices()[0]
     print(f"# device: {device.platform} {device.device_kind}", file=sys.stderr)

@@ -23,3 +23,26 @@ def fmt_hex(data: bytes, max_len: int = 8) -> str:
     """Short hex rendering for logs (reference: ``hex_fmt`` crate usage)."""
     h = data[:max_len].hex()
     return h + ("…" if len(data) > max_len else "")
+
+
+def enable_compilation_cache(path: Optional[str] = None) -> None:
+    """Persist XLA executables to disk across processes.
+
+    The big fori_loop ladder graphs (ops/gcurve, parallel/acs) cost
+    100–250 s to compile on this backend; the persistent cache turns that
+    into a one-time cost per (shape, code) rather than per process.  Safe to
+    call more than once; a failure (unsupported backend) is non-fatal.
+    """
+    import os
+
+    import jax
+
+    if path is None:  # anchor to the repo, not the launch cwd
+        path = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                            ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # pragma: no cover - older jax / unsupported backend
+        pass
