@@ -483,9 +483,6 @@ CONFIGS = {
     "coin256": bench_coin256,
 }
 
-_DEFAULT_SET = list(CONFIGS)
-
-
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", choices=[*CONFIGS, "all"], default="all")
@@ -500,7 +497,7 @@ def main(argv=None):
     device = jax.devices()[0]
     print(f"# device: {device.platform} {device.device_kind}", file=sys.stderr)
 
-    names = _DEFAULT_SET if args.config == "all" else [args.config]
+    names = list(CONFIGS) if args.config == "all" else [args.config]
     results = []
     for name in names:
         try:

@@ -123,6 +123,68 @@ def gf_matrix_to_bits(M: np.ndarray) -> np.ndarray:
 # device helpers -------------------------------------------------------------
 
 
+def gf_mul_jnp(a, b):
+    """Elementwise GF(2^16) multiply on device via log/exp gathers.
+
+    For data×data products (e.g. Gauss–Jordan on survivor-dependent decode
+    matrices).  Constant-matrix products use :func:`gf_apply_bitmatrix`.
+    """
+    import jax.numpy as jnp
+
+    exp = jnp.asarray(GF_EXP[: ORDER - 1].astype(np.int32))
+    log = jnp.asarray(GF_LOG.astype(np.int32))
+    ai = a.astype(jnp.int32)
+    bi = b.astype(jnp.int32)
+    r = exp[(log[ai] + log[bi]) % (ORDER - 1)]
+    nz = (ai != 0) & (bi != 0)
+    return jnp.where(nz, r, 0).astype(jnp.uint16)
+
+
+def gf_inv_jnp(a):
+    """Elementwise GF(2^16) inverse on device; maps 0 → 0 (caller masks)."""
+    import jax.numpy as jnp
+
+    exp = jnp.asarray(GF_EXP[: ORDER].astype(np.int32))
+    log = jnp.asarray(GF_LOG.astype(np.int32))
+    ai = a.astype(jnp.int32)
+    r = exp[(ORDER - 1) - log[ai]]
+    return jnp.where(ai != 0, r, 0).astype(jnp.uint16)
+
+
+def gf_inv_matrix_jnp(M):
+    """Batched GF(2^16) matrix inversion on device — the same generic
+    Gauss–Jordan as :func:`hbbft_tpu.ops.gf256.gf_inv_matrix_jnp` (partial
+    pivoting, first nonzero at-or-below the diagonal; bit-identical to the
+    host :func:`gf_inv_matrix_np`).  Returns ``(inv, ok)``.
+    """
+    import jax.numpy as jnp
+
+    from hbbft_tpu.ops.gf256 import gf_inv_matrix_jnp_impl
+
+    return gf_inv_matrix_jnp_impl(M, gf_mul_jnp, gf_inv_jnp, jnp.uint16)
+
+
+def gf_matrix_to_bits_jnp(M):
+    """Device version of :func:`gf_matrix_to_bits`, batched.
+
+    M: uint16 (..., r, k) → int8 (..., k*16, r*16), same layout as the host
+    function, for data-dependent (per receiver × proposer) decode matrices.
+    """
+    import jax.numpy as jnp
+
+    r, k = M.shape[-2:]
+    powers = jnp.left_shift(
+        jnp.uint16(1), jnp.arange(16, dtype=jnp.uint16)
+    )
+    prod = gf_mul_jnp(M[..., None], powers)  # (..., r, k, 16)
+    bits = (
+        prod[..., None].astype(jnp.uint32) >> jnp.arange(16, dtype=jnp.uint32)
+    ) & 1
+    # (..., r, k, i, b) → (..., k, i, r, b) → (..., k*16, r*16)
+    A = jnp.moveaxis(bits, -4, -2)
+    return A.reshape(*M.shape[:-2], k * 16, r * 16).astype(jnp.int8)
+
+
 def bytes_to_symbol_bits(x):
     """uint8 (..., k, B) shards → int8 bits (..., B//2, k*16).
 
@@ -167,5 +229,5 @@ def gf_apply_bitmatrix(data, bitmat):
 
     dbits = bytes_to_symbol_bits(data)
     obits = jnp.matmul(dbits, bitmat, preferred_element_type=jnp.int32) & 1
-    r = bitmat.shape[1] // 16
+    r = bitmat.shape[-1] // 16  # last axis: bitmat may carry batch dims
     return symbol_bits_to_bytes(obits, r)

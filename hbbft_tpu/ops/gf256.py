@@ -210,27 +210,18 @@ def gf_inv_jnp(a):
     return jnp.where(a != 0, r, 0).astype(jnp.uint8)
 
 
-def gf_inv_matrix_jnp(M):
-    """Batched GF(2^8) matrix inversion on device (Gauss–Jordan).
-
-    M: uint8 (..., n, n) — data-dependent matrices (e.g. the encode-matrix
-    rows of each receiver's surviving shard set, which differ per (node,
-    proposer) under an adversarial drop pattern, so they must be inverted on
-    device).  Returns ``(inv, ok)`` with ``ok`` bool (...,) false for
-    singular inputs (their ``inv`` content is garbage; caller masks).
-
-    The column loop is a ``lax.fori_loop`` (n is static, tiny); every step is
-    vectorized over the batch.  Partial pivoting picks the first nonzero
-    entry at-or-below the diagonal, exactly like the host
-    :func:`gf_inv_matrix_np`, so decode matrices are bit-identical.
+def gf_inv_matrix_jnp_impl(M, mul, inv, dtype):
+    """Field-generic batched Gauss–Jordan on device (char-2 fields: row
+    elimination is XOR).  See :func:`gf_inv_matrix_jnp` for semantics;
+    :mod:`hbbft_tpu.ops.gf16` reuses this with its own ``mul``/``inv``.
     """
     import jax
     import jax.numpy as jnp
 
-    M = jnp.asarray(M, dtype=jnp.uint8)
+    M = jnp.asarray(M, dtype=dtype)
     n = M.shape[-1]
     batch = M.shape[:-2]
-    eye = jnp.broadcast_to(jnp.eye(n, dtype=jnp.uint8), (*batch, n, n))
+    eye = jnp.broadcast_to(jnp.eye(n, dtype=dtype), (*batch, n, n))
     aug0 = jnp.concatenate([M, eye], axis=-1)  # (..., n, 2n)
     rows = jnp.arange(n)
 
@@ -247,24 +238,43 @@ def gf_inv_matrix_jnp(M):
         aug = jnp.take_along_axis(aug, perm[..., None], axis=-2)
         # normalize the pivot row
         pivot_row = aug[..., col, :]  # (..., 2n)
-        pinv = gf_inv_jnp(
+        pinv = inv(
             jnp.take_along_axis(
                 aug[..., col], jnp.broadcast_to(col, (*batch, 1)), axis=-1
             )
         )  # (..., 1) — aug[..., col(row), col(column)]
-        pivot_row = gf_mul_jnp(pivot_row, pinv)
+        pivot_row = mul(pivot_row, pinv)
         aug = jnp.moveaxis(
             jnp.moveaxis(aug, -2, 0).at[col].set(pivot_row), 0, -2
         )
         # eliminate the column everywhere else
         factors = aug[..., :, col]
-        factors = factors * (rows != col).astype(jnp.uint8)
-        aug = aug ^ gf_mul_jnp(factors[..., None], aug[..., col, :][..., None, :])
+        factors = factors * (rows != col).astype(dtype)
+        aug = aug ^ mul(factors[..., None], aug[..., col, :][..., None, :])
         return aug, ok
 
     ok0 = jnp.ones(batch, dtype=bool)
     aug, ok = jax.lax.fori_loop(0, n, body, (aug0, ok0))
     return aug[..., n:], ok
+
+
+def gf_inv_matrix_jnp(M):
+    """Batched GF(2^8) matrix inversion on device (Gauss–Jordan).
+
+    M: uint8 (..., n, n) — data-dependent matrices (e.g. the encode-matrix
+    rows of each receiver's surviving shard set, which differ per (node,
+    proposer) under an adversarial drop pattern, so they must be inverted on
+    device).  Returns ``(inv, ok)`` with ``ok`` bool (...,) false for
+    singular inputs (their ``inv`` content is garbage; caller masks).
+
+    The column loop is a ``lax.fori_loop`` (n is static, tiny); every step is
+    vectorized over the batch.  Partial pivoting picks the first nonzero
+    entry at-or-below the diagonal, exactly like the host
+    :func:`gf_inv_matrix_np`, so decode matrices are bit-identical.
+    """
+    import jax.numpy as jnp
+
+    return gf_inv_matrix_jnp_impl(M, gf_mul_jnp, gf_inv_jnp, jnp.uint8)
 
 
 def gf_matrix_to_bits_jnp(M):

@@ -41,7 +41,7 @@ from typing import Optional
 
 import numpy as np
 
-from hbbft_tpu.ops import gf256
+from hbbft_tpu.ops import gf16, gf256
 from hbbft_tpu.ops import rs as rs_mod
 from hbbft_tpu.ops.merkle import merkle_build_jax, merkle_verify_jax
 
@@ -58,12 +58,34 @@ class BatchedRbc:
         self.f = f
         self.coder = rs_mod.for_n_f(n, f)
         self.k = self.coder.data_shards
-        # N > 256 exceeds GF(2^8): the coder is GF(2^16) and only the
-        # full-delivery scale path is supported (see _run_large)
+        # N > 256 exceeds GF(2^8): the coder (and the masked path's device
+        # decode) switches to GF(2^16); full delivery takes the chunked
+        # scale path (_run_large)
         self.large = n > 256
         self._jit_cache = {}
 
     # ---------------------------------------------------------------- phases
+
+    def _decode_batch(self, surv, use):
+        """Survivor-dependent decode on device, in the coder's field.
+
+        surv: uint8 (..., k, B) survivor shards; use: int (..., k) their
+        row indices in the encode matrix.  Returns ``(data, inv_ok)`` with
+        data (..., k, B) — the batched equivalent of the host
+        ``reconstruct`` (invert the survivor rows, apply as a bit-matrix).
+        """
+        import jax.numpy as jnp
+
+        enc = jnp.asarray(self.coder.matrix)  # (n, k) constant
+        sub = enc[use]  # (..., k, k)
+        if self.large:
+            dec, inv_ok = gf16.gf_inv_matrix_jnp(sub)
+            dec_bits = gf16.gf_matrix_to_bits_jnp(dec)
+            return gf16.gf_apply_bitmatrix(surv, dec_bits), inv_ok
+        dec, inv_ok = gf256.gf_inv_matrix_jnp(sub)
+        dec_bits = gf256.gf_matrix_to_bits_jnp(dec)  # (..., k*8, k*8)
+        out = gf256.gf_apply_bitmatrix(jnp.swapaxes(surv, -1, -2), dec_bits)
+        return jnp.swapaxes(out, -1, -2), inv_ok
 
     def propose(self, data, codeword_tamper=None):
         """Proposer phase: encode + Merkle commit, batched over proposers.
@@ -102,12 +124,13 @@ class BatchedRbc:
         where delivered), ``root`` (P, 32), ``echo_count`` (N, P),
         ``ready_count`` (N, P).
         """
-        if self.large:
-            if any(m is not None for m in (value_mask, echo_mask, ready_mask)):
-                raise NotImplementedError(
-                    "delivery masks are supported up to N=256; the large-N "
-                    "path is full-delivery only"
-                )
+        if self.large and not any(
+            m is not None for m in (value_mask, echo_mask, ready_mask)
+        ):
+            # full-delivery scale path (chunked, root-only Merkle) — the
+            # masked path below also works for N > 256 (GF(2^16) decode on
+            # device) but materializes (receiver, sender, instance) tensors;
+            # callers bound its cost via small P / the `receivers` arg
             return self._run_large(data, codeword_tamper, value_tamper)
         shards, root, proofs, pmask = self.propose(data, codeword_tamper)
         sent = shards if value_tamper is None else shards ^ value_tamper
@@ -217,18 +240,7 @@ class BatchedRbc:
             use[..., None],
             axis=-2,
         )
-        # decode matrices: encode-matrix rows at the survivor indices
-        enc = jnp.asarray(self.coder.matrix)  # (n, k) constant
-        sub = enc[use]  # (l, P, k, k)
-        dec, inv_ok = gf256.gf_inv_matrix_jnp(sub)
-        dec_bits = gf256.gf_matrix_to_bits_jnp(dec)  # (l, P, k*8, k*8)
-        data_rec = jnp.swapaxes(
-            gf256.gf_apply_bitmatrix(
-                jnp.swapaxes(surv, -1, -2), dec_bits
-            ),
-            -1,
-            -2,
-        )  # (l, P, k, B)
+        data_rec, inv_ok = self._decode_batch(surv, use)  # (l, P, k, B)
 
         # -- re-encode + Merkle root check (faulty-proposer detection) -----
         # Reference semantics (``reed-solomon-erasure``'s reconstruct +
@@ -295,14 +307,7 @@ class BatchedRbc:
         use = order[..., :k]  # (P, k)
         surv_ok = jnp.take_along_axis(vv, use, axis=-1).all(axis=-1)
         surv = jnp.take_along_axis(sent, use[..., None], axis=-2)  # (P,k,B)
-        enc = jnp.asarray(self.coder.matrix)
-        sub = enc[use]  # (P, k, k)
-        dec, inv_ok = gf256.gf_inv_matrix_jnp(sub)
-        dec_bits = gf256.gf_matrix_to_bits_jnp(dec)
-        data_rec = jnp.swapaxes(
-            gf256.gf_apply_bitmatrix(jnp.swapaxes(surv, -1, -2), dec_bits),
-            -1, -2,
-        )  # (P, k, B)
+        data_rec, inv_ok = self._decode_batch(surv, use)  # (P, k, B)
 
         full = self.coder.encode_jax(data_rec)  # (P, n, B)
         full_obj = jnp.where(vv[..., None], sent, full)

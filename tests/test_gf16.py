@@ -80,9 +80,7 @@ def test_large_rbc_full_delivery_and_tamper():
     assert out2["echo_count"][0, 5] == n - 1
     assert unframe_value(out2["data"][0, 5]) == values[5]
 
-    # masks are explicitly unsupported at this scale
-    with pytest.raises(NotImplementedError):
-        rbc.run(jnp.asarray(data), value_mask=jnp.ones((n, n), bool))
+    # masks at this scale take the GF(2^16) masked path (separate test)
 
 
 def test_large_acs_agreement():
@@ -96,3 +94,74 @@ def test_large_acs_agreement():
     acc = out["accepted"]
     assert (acc == acc[0]).all() and acc[0].all()
     assert unframe_value(out["data"][0, 42]) == values[42]
+
+
+def test_device_field_ops_match_host():
+    """gf16 device mul/inv and batched Gauss–Jordan vs the host tables."""
+    rng = np.random.default_rng(7)
+    a = rng.integers(0, 1 << 16, size=500, dtype=np.uint16)
+    b = rng.integers(0, 1 << 16, size=500, dtype=np.uint16)
+    got = np.asarray(gf16.gf_mul_jnp(jnp.asarray(a), jnp.asarray(b)))
+    assert np.array_equal(got, gf16.gf_mul(a, b))
+    nz = a[a != 0]
+    got_inv = np.asarray(gf16.gf_inv_jnp(jnp.asarray(nz)))
+    assert np.array_equal(got_inv, gf16.gf_inv(nz))
+
+    k = 6
+    M = rng.integers(0, 1 << 16, size=(5, k, k), dtype=np.uint16)
+    M[4] = 0  # singular member of the batch
+    inv_dev, ok = (np.asarray(x) for x in gf16.gf_inv_matrix_jnp(M))
+    assert not ok[4]
+    for i in range(4):
+        if not ok[i]:
+            continue
+        want = gf16.gf_inv_matrix_np(M[i])
+        assert np.array_equal(inv_dev[i], want), i
+    assert ok[:4].any()  # random 6×6 over GF(2^16): singulars are rare
+
+    bits = np.asarray(gf16.gf_matrix_to_bits_jnp(jnp.asarray(M[:2])))
+    for i in range(2):
+        assert np.array_equal(bits[i], gf16.gf_matrix_to_bits(M[i]))
+
+
+def test_large_rbc_masked_adversarial():
+    """Masked adversarial RBC beyond the GF(2^8) boundary: survivor-set
+    dependent decode with the GF(2^16) device Gauss–Jordan.
+
+    Proposer 1 commits an inconsistent codeword (parity row k+3 corrupted
+    pre-commit).  Receiver 5's echo set is cut so its first-k survivor set
+    leans on that row: it must reconstruct garbage, fail the root re-check,
+    and flag the proposer, while a full-echo receiver delivers — the same
+    deliver/fault split the small-N masked path and the object-mode oracle
+    exhibit (reference: ``Broadcast::compute_output`` re-encode check).
+    """
+    from hbbft_tpu.parallel.rbc import BatchedRbc, frame_values, unframe_value
+
+    n, f = 272, 90
+    rbc = BatchedRbc(n, f)
+    assert rbc.large
+    k = rbc.k
+    P = 2
+    values = [bytes([40 + p]) * 33 for p in range(P)]
+    data = jnp.asarray(frame_values(values, k))
+
+    tam = np.zeros((P, n, data.shape[-1]), dtype=np.uint8)
+    tam[1, k + 3, 0] = 0xA5
+    echo_mask = np.ones((n, n, P), dtype=bool)
+    echo_mask[0:4, 5, :] = False  # receiver 5 loses data sources 0..3
+    receivers = jnp.asarray([0, 5])
+
+    shards, root, proofs, pmask = rbc.propose(
+        data, codeword_tamper=jnp.asarray(tam)
+    )
+    out = rbc.run_from_proposal(
+        shards, root, proofs, pmask,
+        echo_mask=jnp.asarray(echo_mask), receivers=receivers,
+    )
+    d = np.asarray(out["delivered"])
+    fl = np.asarray(out["fault"])
+    assert d[0].all()  # receiver 0: full echoes → delivers both
+    assert unframe_value(np.asarray(out["data"][0, 1])) == values[1]
+    assert d[1, 0] and not d[1, 1]  # receiver 5: p0 ok, p1 poisoned
+    assert fl[1, 1] and not fl[1, 0]
+    assert unframe_value(np.asarray(out["data"][1, 0])) == values[0]
