@@ -308,6 +308,46 @@ def bench_rbc_round(n: int = 64, f: int = 21, msg_len: int = 512):
     }
 
 
+def bench_dkg256(t: int = 85):
+    """DKG hot loop at the N=256 network shape (t = f = 85): a dealer
+    commitment's ``row(x)`` check — (t+1)² G1 scalar-muls, done per Part by
+    every node (SURVEY §7 "hard part #3") — device GLV ladder vs the C++
+    oracle's per-mul path."""
+    import random
+
+    from hbbft_tpu.crypto import batch as BT
+    from hbbft_tpu.crypto import tc
+
+    rng = random.Random(21)
+    print(f"# dkg256: sampling a degree-{t} bivariate poly…", file=sys.stderr)
+    bp = tc.BivarPoly.random(t, rng)
+    com = bp.commitment()
+
+    BT.commitment_row(com, 3)  # compile/warm
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        row_dev = BT.commitment_row(com, 3)
+        times.append(time.perf_counter() - t0)
+    t_dev = float(np.median(times))
+
+    t0 = time.perf_counter()
+    row_host = com.row(3)
+    t_host = time.perf_counter() - t0
+    assert row_dev == row_host
+
+    muls = (t + 1) * (t + 1)
+    return {
+        "metric": "dkg256_commitment_row",
+        "value": round(muls / t_dev, 2),
+        "unit": "scalar-muls/s",
+        "vs_baseline": round(t_host / t_dev, 2),
+        "t_device_s": round(t_dev, 6),
+        "t_host_s": round(t_host, 6),
+        "shape": f"t={t} (N=256 f=85)",
+    }
+
+
 def bench_coin256(n: int = 256, f: int = 85):
     """BASELINE config 3: common-coin share verification at N=256 —
     randomized-linear-combination batch verify (device G1+G2 ladders + one
@@ -481,6 +521,7 @@ CONFIGS = {
     "rbc64-reconstruct": bench_rbc64_reconstruct,
     "sha3": bench_sha3,
     "coin256": bench_coin256,
+    "dkg256": bench_dkg256,
 }
 
 def main(argv=None):

@@ -66,6 +66,50 @@ G2_GEN = (
     (1, 0),
 )
 
+# GLV endomorphism on G1: φ(x, y) = (β·x, y) with β a primitive cube root
+# of unity in Fp acts as multiplication by λ = x²−1 on the r-order subgroup
+# (λ² + λ + 1 = x⁴ − x² + 1 ≡ 0 mod r).  Any full-range scalar splits as
+# s = a + b·λ with a = s mod λ, b = s // λ, both POSITIVE and < 2^128 —
+# the regime the device's lazy ladder is sound in (ops/fp381.py).  β is
+# derived, not transcribed: the cube root (g^((p−1)/3)) whose φ matches
+# λ·G1_GEN is selected at import.
+LAMBDA_G1 = _x**2 - 1
+assert (LAMBDA_G1**2 + LAMBDA_G1 + 1) % R == 0
+assert 0 < LAMBDA_G1 < 1 << 128 and (R - 1) // LAMBDA_G1 < 1 << 128
+
+
+def _derive_beta() -> int:
+    for g in range(2, 100):
+        b = pow(g, (P - 1) // 3, P)
+        if b != 1:
+            break
+    x, y, _ = G1_GEN
+    for cand in (b, b * b % P):
+        # φ(G) = (βx, y) must equal λ·G
+        lam = g1_mul(G1_GEN, LAMBDA_G1)
+        if g1_eq((cand * x % P, y, 1), lam):
+            return cand
+    raise AssertionError("no cube root matches the G1 endomorphism")
+
+
+BETA_G1: Optional[int] = None  # filled lazily (needs g1_mul below)
+
+
+def glv_beta() -> int:
+    global BETA_G1
+    if BETA_G1 is None:
+        BETA_G1 = _derive_beta()
+    return BETA_G1
+
+
+def g1_endo(pt):
+    """φ(P) = λ·P via one field multiplication (Jacobian: scale X by β)."""
+    if pt is None:
+        return None
+    b = glv_beta()
+    return (pt[0] * b % P, pt[1], pt[2])
+
+
 # --------------------------------------------------------------------------
 # Fp
 # --------------------------------------------------------------------------

@@ -57,6 +57,41 @@ def limbs_to_int(limbs) -> int:
     return x
 
 
+_LIMB_WEIGHTS = np.array(
+    [1 << (LIMB_BITS * i) for i in range(NL)], dtype=object
+)
+
+
+def ints_to_limbs_batch(xs, n: int = NL) -> np.ndarray:
+    """Host: list of ints in [0, 2^(13n)) → (B, n) int32 limbs, vectorized
+    (bytes → unpackbits → 13-bit regroup; ~100× the per-int Python loop)."""
+    nbytes = (LIMB_BITS * n + 7) // 8
+    buf = b"".join(x.to_bytes(nbytes, "little") for x in xs)
+    raw = np.frombuffer(buf, dtype=np.uint8).reshape(len(xs), nbytes)
+    bits = np.unpackbits(raw, axis=1, bitorder="little")[:, : LIMB_BITS * n]
+    w = (1 << np.arange(LIMB_BITS, dtype=np.int32)).astype(np.int32)
+    return (bits.reshape(len(xs), n, LIMB_BITS) * w).sum(-1, dtype=np.int32)
+
+
+def limbs_to_ints_batch(limbs) -> list:
+    """Host: (B, NL) limb array (any digit magnitudes — lazy values allowed)
+    → list of python ints, via one object-dtype matvec instead of a per-limb
+    Python loop."""
+    arr = np.asarray(limbs)
+    return list(arr.astype(object) @ _LIMB_WEIGHTS[: arr.shape[-1]])
+
+
+def bits_batch(xs, nbits: int) -> np.ndarray:
+    """Host: ints → (B, nbits) int32 little-endian bits, vectorized."""
+    nbytes = (nbits + 7) // 8
+    buf = b"".join(x.to_bytes(nbytes, "little") for x in xs)
+    raw = np.frombuffer(buf, dtype=np.uint8).reshape(len(xs), nbytes)
+    return (
+        np.unpackbits(raw, axis=1, bitorder="little")[:, :nbits]
+        .astype(np.int32)
+    )
+
+
 P_LIMBS = int_to_limbs(P)
 
 # fold rows for full-product reduction: position j in [NL, 2*NL) contributes

@@ -298,28 +298,18 @@ def msm(o, pt, bits):
 
 def scalars_to_bits(scalars: Sequence[int], nbits: int = R_BITS) -> np.ndarray:
     """ints (mod r) → (B, nbits) int32 little-endian bits."""
-    out = np.zeros((len(scalars), nbits), dtype=np.int32)
-    for i, s in enumerate(scalars):
-        s %= R
-        assert s < (1 << nbits), "scalar exceeds ladder width"
-        for b in range(nbits):
-            out[i, b] = (s >> b) & 1
-    return out
+    sc = [s % R for s in scalars]
+    assert all(s < (1 << nbits) for s in sc), "scalar exceeds ladder width"
+    return F.bits_batch(sc, nbits)
 
 
 def g1_to_device(points: Sequence[Optional[tuple]]) -> Tuple:
     """Host Jacobian G1 points (or None) → stacked device limb arrays."""
-    xs, ys, zs = [], [], []
+    coords = ([], [], [])
     for p in points:
-        if p is None:
-            xs.append(np.zeros(F.NL, np.int32))
-            ys.append(np.zeros(F.NL, np.int32))
-            zs.append(np.zeros(F.NL, np.int32))
-        else:
-            xs.append(F.int_to_limbs(p[0] % F.P))
-            ys.append(F.int_to_limbs(p[1] % F.P))
-            zs.append(F.int_to_limbs(p[2] % F.P))
-    return (np.stack(xs), np.stack(ys), np.stack(zs))
+        for ci in range(3):
+            coords[ci].append(0 if p is None else p[ci] % F.P)
+    return tuple(F.ints_to_limbs_batch(cs) for cs in coords)
 
 
 def g1_from_device(pt) -> Optional[tuple]:
@@ -339,11 +329,51 @@ def g2_to_device(points: Sequence[Optional[tuple]]) -> Tuple:
         if p is None:
             p = ((0, 0), (0, 0), (0, 0))
         for ci, c in enumerate(p):
-            coords[ci][0].append(F.int_to_limbs(c[0] % F.P))
-            coords[ci][1].append(F.int_to_limbs(c[1] % F.P))
+            coords[ci][0].append(c[0] % F.P)
+            coords[ci][1].append(c[1] % F.P)
     return tuple(
-        (np.stack(re), np.stack(im)) for (re, im) in coords
+        (F.ints_to_limbs_batch(re), F.ints_to_limbs_batch(im))
+        for (re, im) in coords
     )
+
+
+def g1_from_device_batch(pt) -> list:
+    """Device (X, Y, Z) limb arrays with a leading batch axis → list of host
+    Jacobian points (None = infinity).  Canonicalizes on host; one
+    object-dtype matvec per coordinate instead of a per-point limb loop."""
+    xs, ys, zs = (
+        F.limbs_to_ints_batch(np.asarray(c).reshape(-1, F.NL)) for c in pt
+    )
+    return [
+        None if (z % F.P) == 0 else (x % F.P, y % F.P, z % F.P)
+        for x, y, z in zip(xs, ys, zs)
+    ]
+
+
+def g2_from_device_batch(pt) -> list:
+    """Device G2 ((re, im) limb-pair coords, leading batch axis) → list of
+    host Jacobian points (None = infinity)."""
+    (xr, xi), (yr, yi), (zr, zi) = (
+        tuple(
+            F.limbs_to_ints_batch(np.asarray(c).reshape(-1, F.NL))
+            for c in coord
+        )
+        for coord in pt
+    )
+    out = []
+    for i in range(len(zr)):
+        z = (zr[i] % F.P, zi[i] % F.P)
+        if z == (0, 0):
+            out.append(None)
+            continue
+        out.append(
+            (
+                (xr[i] % F.P, xi[i] % F.P),
+                (yr[i] % F.P, yi[i] % F.P),
+                z,
+            )
+        )
+    return out
 
 
 def g2_from_device(pt) -> Optional[tuple]:

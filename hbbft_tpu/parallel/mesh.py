@@ -3,14 +3,22 @@
 The scaling story (SURVEY.md §2.3): the node axis is the data-parallel axis.
 Each device owns a contiguous slice of nodes — it runs their proposer phase
 locally and their receiver phase locally; the *network* between the phases is
-an ``all_gather`` over the mesh axis (every node's proposal must reach every
+an ``all_gather`` over the mesh axes (every node's proposal must reach every
 node — exactly RBC's Value/Echo fan-out), riding ICI between chips instead
 of a message queue.  Counting phases are replicated (they are O(N²·P) bool
 ops — noise); the heavy per-receiver decode work is sharded.
 
+Multi-host: pass a TWO-axis mesh (conventionally ``("dcn", "ici")`` — hosts
+over the data-center network × chips over ICI).  The node axis shards over
+both; the proposal fan-out is hierarchical — gather over the innermost
+(ICI) axis first, so the expensive cross-host hop moves each shard once,
+already host-aggregated, instead of once per chip.  On real hardware build
+the mesh from ``jax.distributed``-initialized global devices (one process
+per host); the virtual CPU mesh used by tests and the driver's
+``dryrun_multichip`` exercises the same code path with the same collectives.
+
 The same function runs on a real multi-chip mesh or on the virtual
-`--xla_force_host_platform_device_count` CPU mesh used by tests and the
-driver's ``dryrun_multichip`` contract.
+`--xla_force_host_platform_device_count` CPU mesh.
 """
 
 from __future__ import annotations
@@ -20,13 +28,37 @@ import numpy as np
 from hbbft_tpu.parallel.rbc import BatchedRbc
 
 
+def _gather_nodes(x, axes):
+    """all_gather the leading (node-sharded) axis back to full size —
+    innermost mesh axis (ICI) first, then outward (DCN), so each cross-host
+    transfer carries the host's already-gathered block once."""
+    import jax
+
+    for ax in reversed(axes):
+        x = jax.lax.all_gather(x, ax, tiled=True)
+    return x
+
+
+def _flat_device_index(axes):
+    """This device's rank in the node-axis sharding (row-major over mesh
+    axes, matching ``PartitionSpec((*axes,))``)."""
+    import jax
+
+    idx = jax.lax.axis_index(axes[0])
+    for ax in axes[1:]:
+        idx = idx * jax.lax.axis_size(ax) + jax.lax.axis_index(ax)
+    return idx
+
+
 def sharded_rbc_run(rbc: BatchedRbc, mesh, data, codeword_tamper=None,
                     value_tamper=None, value_mask=None, echo_mask=None,
                     ready_mask=None):
-    """Full batched RBC round with node axis sharded over ``mesh``.
+    """Full batched RBC round with the node axis sharded over ``mesh``.
 
-    ``data``: uint8 (P, k, B) with P == rbc.n divisible by the mesh size.
-    Masks/tampers as in :meth:`BatchedRbc.run` (replicated).
+    ``mesh`` may have one axis (single-host chips over ICI) or two
+    (hosts × chips — DCN × ICI); ``data``: uint8 (P, k, B) with
+    P == rbc.n divisible by the total device count.  Masks/tampers as in
+    :meth:`BatchedRbc.run` (replicated).
 
     Returns the same dict as ``BatchedRbc.run`` with per-receiver arrays
     gathered back to full size, so results are directly comparable with the
@@ -34,11 +66,11 @@ def sharded_rbc_run(rbc: BatchedRbc, mesh, data, codeword_tamper=None,
     """
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
     n = rbc.n
-    (axis,) = mesh.axis_names
+    axes = tuple(mesh.axis_names)
     n_dev = mesh.devices.size
     assert n % n_dev == 0, (n, n_dev)
     per = n // n_dev
@@ -59,12 +91,13 @@ def sharded_rbc_run(rbc: BatchedRbc, mesh, data, codeword_tamper=None,
         # d: local (per, k, B) — this device's proposers
         shards, root, proofs, pmask = rbc.propose(d, cw)
         shards = shards ^ vt
-        # the "network": every proposal reaches every node over ICI
-        shards = jax.lax.all_gather(shards, axis, tiled=True)   # (P, n, B)
-        root = jax.lax.all_gather(root, axis, tiled=True)       # (P, 32)
-        proofs = jax.lax.all_gather(proofs, axis, tiled=True)   # (P, n, D, 32)
+        # the "network": every proposal reaches every node — ICI inside a
+        # host, one host-aggregated hop over DCN on a two-axis mesh
+        shards = _gather_nodes(shards, axes)   # (P, n, B)
+        root = _gather_nodes(root, axes)       # (P, 32)
+        proofs = _gather_nodes(proofs, axes)   # (P, n, D, 32)
         # receiver phase for this device's slice of nodes
-        me = jax.lax.axis_index(axis)
+        me = _flat_device_index(axes)
         receivers = me * per + jnp.arange(per)
         out = rbc.run_from_proposal(
             shards, root, proofs, pmask,
@@ -73,7 +106,7 @@ def sharded_rbc_run(rbc: BatchedRbc, mesh, data, codeword_tamper=None,
         )
         return out
 
-    spec_p = P(axis)        # sharded over proposers/receivers (leading axis)
+    spec_p = P(axes)        # sharded over proposers/receivers (leading axis)
     spec_r = P()            # replicated
 
     in_specs = (spec_p, spec_p, spec_p, spec_r, spec_r, spec_r)

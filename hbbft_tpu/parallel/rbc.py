@@ -109,6 +109,7 @@ class BatchedRbc:
         ready_mask=None,
         codeword_tamper=None,
         value_tamper=None,
+        receivers=None,
     ):
         """One full batched RBC execution (Value→Echo→Ready→decode).
 
@@ -117,6 +118,8 @@ class BatchedRbc:
         echo_mask: bool (N, N, P) — Echo i→j for p delivered (default all).
         ready_mask: bool (N, N, P) — Ready i→j for p delivered (default all).
         codeword_tamper / value_tamper: uint8 (P, N, B) XOR patterns.
+        receivers: optional int array — restrict the per-receiver decode of
+        the masked path (its cost bound at large N; see run_from_proposal).
 
         Returns a dict of arrays:
         ``delivered`` bool (N, P), ``fault`` bool (N, P) (proposer proven
@@ -124,7 +127,7 @@ class BatchedRbc:
         where delivered), ``root`` (P, 32), ``echo_count`` (N, P),
         ``ready_count`` (N, P).
         """
-        if self.large and not any(
+        if self.large and receivers is None and not any(
             m is not None for m in (value_mask, echo_mask, ready_mask)
         ):
             # full-delivery scale path (chunked, root-only Merkle) — the
@@ -135,7 +138,8 @@ class BatchedRbc:
         shards, root, proofs, pmask = self.propose(data, codeword_tamper)
         sent = shards if value_tamper is None else shards ^ value_tamper
         return self.run_from_proposal(
-            sent, root, proofs, pmask, value_mask, echo_mask, ready_mask
+            sent, root, proofs, pmask, value_mask, echo_mask, ready_mask,
+            receivers=receivers,
         )
 
     def run_from_proposal(

@@ -114,9 +114,11 @@ class SyncKeyGen:
 
     def generate_part(self) -> Part:
         """Sample our bivariate poly and deal rows (done once, by dealers)."""
+        from hbbft_tpu.crypto import batch as _batch
+
         n = len(self.ids)
         bp = tc.BivarPoly.random(self.threshold, self.rng)
-        commitment = bp.commitment()
+        commitment = _batch.bivar_commitment(bp)
         rows = []
         for j in range(n):
             row = bp.row(j + 1)
@@ -147,8 +149,13 @@ class SyncKeyGen:
         row = _de_poly(row_bytes) if row_bytes is not None else None
         if row is None or row.degree() > self.threshold:
             return PartOutcome(fault=FaultKind.InvalidPart)
-        # check the row against the dealer's commitment
-        if part.commitment.row(self.our_index + 1) != row.commitment():
+        # check the row against the dealer's commitment (device-batched at
+        # large (t+1)² — SURVEY §7 hard part #3)
+        from hbbft_tpu.crypto import batch as _batch
+
+        if _batch.commitment_row(
+            part.commitment, self.our_index + 1
+        ) != row.commitment():
             return PartOutcome(fault=FaultKind.InvalidPart)
         self._row_polys[dealer] = row
         self.our_rows[dealer] = row.evaluate(0)
@@ -179,10 +186,11 @@ class SyncKeyGen:
                 return AckOutcome(fault=FaultKind.InvalidAck)
             v = int.from_bytes(val_bytes, "big")
             # g1^v must equal commitment_d(acker+1, our+1)
+            from hbbft_tpu.crypto import batch as _batch
             from hbbft_tpu.crypto import bls12_381 as bls
 
-            expect = self.parts[dealer].evaluate(
-                acker + 1, self.our_index + 1
+            expect = _batch.commitment_eval(
+                self.parts[dealer], acker + 1, self.our_index + 1
             )
             if not bls.g1_eq(bls.g1_mul(bls.G1_GEN, v), expect):
                 return AckOutcome(fault=FaultKind.InvalidAck)

@@ -82,3 +82,32 @@ def test_sharded_matches_single_device_with_masks_and_tamper(mesh8):
     for key in single:
         np.testing.assert_array_equal(sharded[key], single[key], err_msg=key)
     assert sharded["delivered"].any()
+
+
+def test_sharded_2d_mesh_matches_single_device(mesh8):
+    """Hierarchical (hosts × chips — DCN × ICI) mesh: the node axis shards
+    over both axes; the Value/Echo fan-out gathers ICI-first.  Results must
+    be bit-identical to the 1-axis mesh and the single-device path."""
+    devs = jax.devices()[:8]
+    mesh2d = Mesh(np.array(devs).reshape(2, 4), ("dcn", "ici"))
+
+    n, f = 8, 2
+    rbc = BatchedRbc(n, f)
+    values = [bytes([p + 3]) * 7 for p in range(n)]
+    data = jnp.asarray(frame_values(values, rbc.k))
+
+    ones_vm = jnp.ones((n, n), dtype=bool)
+    ones_em = jnp.ones((n, n, n), dtype=bool)
+    single = {
+        k: np.asarray(v)
+        for k, v in jax.jit(rbc.run)(
+            data, value_mask=ones_vm, echo_mask=ones_em, ready_mask=ones_em
+        ).items()
+    }
+    sharded = {
+        k: np.asarray(v)
+        for k, v in sharded_rbc_run(rbc, mesh2d, data).items()
+    }
+    for key in single:
+        np.testing.assert_array_equal(sharded[key], single[key], err_msg=key)
+    assert sharded["delivered"].all()
