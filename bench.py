@@ -14,10 +14,19 @@ Prints exactly ONE JSON line to stdout:
 Per-config detail goes to stderr.
 
 Configs (BASELINE.md):
-  rbc64    N=64 f=21 RBC shard pipeline: RS encode + Merkle build,
-           batched over 64 proposer instances (one ACS round's proposals).
+  hb-epoch  full batched HoneyBadger epoch (TPKE → RBC → ABA → decrypt)
+            vs the object-mode simulator (config-1 shape at N=16) — the
+            headline metric.
+  acs1024   BASELINE config 4: full ACS at N=1024 (GF(2^16) coder).
+  rbc-round one full batched RBC round (N=64) vs object mode.
+  rbc64     N=64 f=21 RBC shard pipeline: RS encode + Merkle build,
+            batched over 64 proposer instances (one ACS round's proposals).
   rbc64-reconstruct   RS reconstruct from the worst-case survivor set.
-  sha3     batched SHA3-256 digests (Merkle/coin workhorse).
+  sha3      batched SHA3-256 digests (Merkle/coin workhorse).
+  coin256   BASELINE config 3: randomized-linear-combination batch verify
+            of 256 signature shares (device ladders + one pairing check).
+  dkg256    DKG hot loop: BivarCommitment.row at t=85 (device GLV ladder
+            vs the C++ oracle).
 """
 
 from __future__ import annotations
