@@ -17,6 +17,8 @@ Configs (BASELINE.md):
   hb-epoch  full batched HoneyBadger epoch (TPKE → RBC → ABA → decrypt)
             vs the object-mode simulator (config-1 shape at N=16) — the
             headline metric.
+  hb-epoch64  the same full epoch at N=64 f=21 (batched share production
+            + Lagrange combine); host baseline extrapolated from N=16.
   acs1024   BASELINE config 4: full ACS at N=1024 (GF(2^16) coder).
   rbc-round one full batched RBC round (N=64) vs object mode.
   rbc64     N=64 f=21 RBC shard pipeline: RS encode + Merkle build,
@@ -465,6 +467,73 @@ def bench_hb_epoch(n: int = 16, tx_bytes: int = 256):
     }
 
 
+def bench_hb_epoch64(n: int = 64, tx_bytes: int = 256):
+    """A FULL TPKE HoneyBadger epoch at N=64 (f=21) — encryption, batched
+    ACS, real threshold coins, and one fused device ladder launch for the
+    Lagrange-combined decryption masks of all accepted ciphertexts.  Host
+    baseline extrapolated from the N=16 object-mode epoch (message count
+    scales ~N³)."""
+    import random
+
+    from hbbft_tpu.netinfo import NetworkInfo
+    from hbbft_tpu.parallel.acs import BatchedHoneyBadgerEpoch
+    from hbbft_tpu.protocols.honey_badger import (
+        Batch, EncryptionSchedule, HoneyBadger,
+    )
+    from hbbft_tpu.sim import NetBuilder, NullAdversary
+
+    rng = random.Random(23)
+    print(f"# hb-epoch64: generating keys for N={n}…", file=sys.stderr)
+    infos = NetworkInfo.generate_map(list(range(n)), rng)
+    contribs = {
+        i: bytes(rng.randrange(256) for _ in range(tx_bytes)) for i in range(n)
+    }
+    hb = BatchedHoneyBadgerEpoch(infos, session_id=b"bench64")
+    batch0, _ = hb.run(contribs, random.Random(1), encrypt=True)  # compile
+    assert batch0 == contribs
+    times = []
+    for i in range(3):
+        t0 = time.perf_counter()
+        batch, _ = hb.run(contribs, random.Random(2 + i), encrypt=True)
+        times.append(time.perf_counter() - t0)
+        assert batch == contribs
+    t_dev = float(np.median(times))
+
+    # object-mode baseline measured at N=16, scaled by the ~N³ message count
+    small = 16
+    s_infos = NetworkInfo.generate_map(list(range(small)), random.Random(5))
+    s_contribs = {i: contribs[i] for i in range(small)}
+    net = NetBuilder(list(range(small))).adversary(NullAdversary()).using_step(
+        lambda nid: HoneyBadger.builder(s_infos[nid])
+        .session_id(b"bench64")
+        .encryption_schedule(EncryptionSchedule.always())
+        .rng(random.Random(200 + nid))
+        .build()
+    )
+    t0 = time.perf_counter()
+    for nid in net.node_ids():
+        net.send_input(nid, s_contribs[nid])
+    net.run_to_quiescence()
+    t_small = time.perf_counter() - t0
+    for nid in net.node_ids():
+        assert any(isinstance(o, Batch) for o in net.nodes[nid].outputs)
+    per_msg = t_small / max(net.messages_delivered, 1)
+    est_msgs = net.messages_delivered * (n / small) ** 3
+    t_host_est = per_msg * est_msgs
+
+    return {
+        "metric": "hb_epoch64_batched",
+        "value": round(1.0 / t_dev, 3),
+        "unit": "epochs/s",
+        "vs_baseline": round(t_host_est / t_dev, 1),
+        "t_device_s": round(t_dev, 4),
+        "t_host_est_s": round(t_host_est, 1),
+        "host_note": f"extrapolated from N={small} object-mode "
+                     f"({net.messages_delivered} msgs in {t_small:.2f}s)",
+        "shape": f"N={n} f={(n - 1) // 3} tx={tx_bytes}B",
+    }
+
+
 def bench_acs1024(n: int = 1024):
     """BASELINE config 4: a full ACS (batched RBC + batched ABA) over
     N=1024 nodes — beyond the reference's reach entirely (its GF(2^8)
@@ -524,6 +593,7 @@ def bench_acs1024(n: int = 1024):
 
 CONFIGS = {
     "hb-epoch": bench_hb_epoch,
+    "hb-epoch64": bench_hb_epoch64,
     "acs1024": bench_acs1024,
     "rbc-round": bench_rbc_round,
     "rbc64": bench_rbc64,

@@ -158,6 +158,7 @@ class BatchedHoneyBadgerEpoch:
         # map delivering receivers to rows in the data array once
         # (the full-delivery fast path returns one shared row)
         row_of = {int(r): i for i, r in enumerate(out["data_receivers"])}
+        pending: List[Tuple] = []  # (nid, payload)
         for p, nid in enumerate(self.ids):
             if not row[p]:
                 continue
@@ -175,14 +176,24 @@ class BatchedHoneyBadgerEpoch:
             if payload is None:
                 continue
             if encrypt:
-                ct = tc.Ciphertext.from_bytes(payload)
-                shares = {}
-                for j, onid in enumerate(self.ids[: t + 1]):
-                    info = self.netinfo_map[onid]
-                    shares[info.node_index(onid)] = (
-                        info.secret_key_share().decrypt_share(ct, check=False)
-                    )
-                batch[nid] = pks.decrypt(shares, ct)
+                pending.append((nid, tc.Ciphertext.from_bytes(payload)))
             else:
                 batch[nid] = payload
+        if encrypt and pending:
+            # all accepted ciphertexts decrypt in one batched pass (device
+            # ladders above the size threshold, host loop below it)
+            from hbbft_tpu.crypto.batch import batch_tpke_decrypt
+
+            shares = [
+                (
+                    self.netinfo_map[onid].node_index(onid),
+                    self.netinfo_map[onid].secret_key_share(),
+                )
+                for onid in self.ids[: t + 1]
+            ]
+            plaintexts = batch_tpke_decrypt(
+                pks, [ct for _, ct in pending], shares
+            )
+            for (nid, _), pt in zip(pending, plaintexts):
+                batch[nid] = pt
         return batch, out

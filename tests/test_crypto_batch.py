@@ -54,3 +54,22 @@ def test_dec_share_batch_accepts_valid_and_rejects_bad(keys):
     assert batch_verify_dec_shares(pairs, ct, rng) is True
     bad = pairs[:1] + [(pairs[1][0], pairs[2][1])] + pairs[2:]
     assert batch_verify_dec_shares(bad, ct, rng) is False
+
+
+def test_batch_tpke_decrypt_host_and_device_paths(keys):
+    from hbbft_tpu.crypto import batch as BT
+
+    rng, sks, pks = keys
+    pk = pks.public_key()
+    msgs = [b"m%d" % i * (i + 1) for i in range(4)]
+    cts = [pk.encrypt(m, rng) for m in msgs]
+    shares = [(i, sks.secret_key_share(i)) for i in range(pks.threshold() + 2)]
+
+    assert BT.batch_tpke_decrypt(pks, cts, shares) == msgs  # host path
+    old = BT.DEVICE_DECRYPT_MIN_BATCH
+    try:
+        BT.DEVICE_DECRYPT_MIN_BATCH = 1  # force the device ladder path
+        assert BT.batch_tpke_decrypt(pks, cts, shares) == msgs
+        assert BT.batch_tpke_decrypt(pks, [], shares) == []
+    finally:
+        BT.DEVICE_DECRYPT_MIN_BATCH = old
