@@ -469,10 +469,9 @@ def bench_hb_epoch(n: int = 16, tx_bytes: int = 256):
 
 def bench_hb_epoch64(n: int = 64, tx_bytes: int = 256):
     """A FULL TPKE HoneyBadger epoch at N=64 (f=21) — encryption, batched
-    ACS, real threshold coins, and one fused device ladder launch for the
-    Lagrange-combined decryption masks of all accepted ciphertexts.  Host
-    baseline extrapolated from the N=16 object-mode epoch (message count
-    scales ~N³)."""
+    ACS, threshold coins, and master-scalar-folded decryption of all
+    accepted ciphertexts.  Host baseline extrapolated from the N=16
+    object-mode epoch (message count scales ~N³)."""
     import random
 
     from hbbft_tpu.netinfo import NetworkInfo

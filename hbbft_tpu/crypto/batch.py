@@ -149,11 +149,11 @@ _CACHE = _MsmCache()
 # --------------------------------------------------------------------------
 
 
-# crossover for the decrypt batch (A ciphertexts × t+1 shares): one fused
-# ladder launch vs A·(t+1) sequential C++ scalar-muls — measured on the
-# tunneled v5e chip at the N=64 shape (1408 muls): device 0.92 s vs host
-# 1.38 s, so the device takes over around ~1k muls
-DEVICE_DECRYPT_MIN_BATCH = 1024
+# crossover for the decrypt batch: with the master-scalar fold the cost is
+# ONE scalar-mul per ciphertext, so the device ladder only pays off once the
+# ciphertext count alone is large (C++ oracle ≈ 0.5 ms/mul → host beats the
+# ~2 s ladder launch until A is in the thousands)
+DEVICE_DECRYPT_MIN_BATCH = 4096
 
 
 def batch_tpke_decrypt(pks, cts, secret_shares):
@@ -161,12 +161,13 @@ def batch_tpke_decrypt(pks, cts, secret_shares):
 
     ``secret_shares``: (index, SecretKeyShare) pairs, ≥ t+1 of them (the
     first t+1 by index are used, matching ``PublicKeySet.decrypt``'s share
-    selection).  The masks Σ_i λ_i·x_i·U_p for ALL ciphertexts come from a
-    single batched device ladder launch over the fused scalars
-    (λ_i·x_i mod r) — share production is folded into the Lagrange combine,
-    the same documented god-view shortcut as the simulator's once-per-
-    proposer decryption (per-node share traffic is the cost model's
-    business).  Returns the plaintext list, index-aligned with ``cts``.
+    selection).  Because every share of ciphertext p has the same base
+    (D_{p,i} = x_i·U_p), the Lagrange combine collapses to a master-scalar
+    fold: mask_p = Σ_i λ_i·x_i·U_p = f(0)·U_p — ONE scalar-mul per
+    ciphertext, batched on device above ``DEVICE_DECRYPT_MIN_BATCH``.
+    The same documented god-view shortcut as the simulator's once-per-
+    proposer decryption (per-node share traffic/verification is the cost
+    model's business).  Returns the plaintext list, aligned with ``cts``.
     """
     from hbbft_tpu.crypto import tc
 
@@ -176,27 +177,17 @@ def batch_tpke_decrypt(pks, cts, secret_shares):
         raise ValueError(f"need {t + 1} shares, got {len(items)}")
     if not cts:
         return []
-    k1 = t + 1
-    if not _device_worthwhile(len(cts) * k1, DEVICE_DECRYPT_MIN_BATCH):
-        out = []
-        for ct in cts:
-            shares = {
-                i: sk.decrypt_share(ct, check=False) for i, sk in items
-            }
-            out.append(pks.decrypt(shares, ct))
-        return out
-
     lams = tc._lagrange_coeffs_at_zero([i + 1 for i, _ in items])
-    fused = [lam * sk.scalar % tc.R for (_, sk), lam in zip(items, lams)]
-    pts = [ct.u for ct in cts for _ in items]
-    scs = [s for _ in cts for s in fused]
-    L = _CACHE.g1_mul_batch(pts, scs)  # λ_i·x_i·U_p for every (p, i)
+    master = sum(lam * sk.scalar for (_, sk), lam in zip(items, lams)) % tc.R
+    if _device_worthwhile(len(cts), DEVICE_DECRYPT_MIN_BATCH):
+        masks = _CACHE.g1_mul_batch(
+            [ct.u for ct in cts], [master] * len(cts)
+        )
+    else:
+        masks = [c.g1_mul(ct.u, master) for ct in cts]
     out = []
-    for p, ct in enumerate(cts):
-        acc = None
-        for i in range(k1):
-            acc = c.g1_add(acc, L[p * k1 + i])
-        stream = tc._kdf_stream(c.g1_to_bytes(acc), len(ct.v))
+    for ct, mask in zip(cts, masks):
+        stream = tc._kdf_stream(c.g1_to_bytes(mask), len(ct.v))
         out.append(bytes(a ^ b for a, b in zip(ct.v, stream)))
     return out
 

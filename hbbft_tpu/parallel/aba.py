@@ -272,9 +272,18 @@ class BatchedAba:
 
 
 def coin_for(netinfo_map, session_id: bytes, proposer_id, epoch: int) -> bool:
-    """The threshold-coin value for (instance, epoch) — computed once by
-    combining t+1 REAL signature shares (host/native), as the simulator's
-    god-view shortcut for the N-redundant share exchange."""
+    """The threshold-coin value for (instance, epoch).
+
+    God-view shortcut (same class as the simulator's once-per-proposer
+    decryption): the combined signature equals H(nonce)^{f(0)} — Lagrange
+    in the exponent — so the master scalar f(0) = Σ λ_i·x_i is
+    interpolated once from t+1 secret shares (cheap mod-r arithmetic) and
+    ONE G2 scalar-mul replaces the t+1 share signs + combine.  The result
+    is bit-identical to ``PublicKeySet.combine_signatures`` over any t+1
+    valid shares (interpolation uniqueness); the N-redundant share
+    exchange/verification of a real deployment is the cost model's
+    business."""
+    from hbbft_tpu.crypto import bls12_381 as c
     from hbbft_tpu.crypto import tc
 
     nonce = (
@@ -287,9 +296,15 @@ def coin_for(netinfo_map, session_id: bytes, proposer_id, epoch: int) -> bool:
     infos = list(netinfo_map.values())
     pks = infos[0].public_key_set()
     t = pks.threshold()
-    shares = {}
     ids = sorted(netinfo_map.keys(), key=repr)
-    for nid in ids[: t + 1]:
-        info = netinfo_map[nid]
-        shares[info.node_index(nid)] = info.secret_key_share().sign(nonce)
-    return pks.combine_signatures(shares).parity()
+    items = [
+        (
+            netinfo_map[nid].node_index(nid),
+            netinfo_map[nid].secret_key_share().scalar,
+        )
+        for nid in ids[: t + 1]
+    ]
+    items.sort()
+    lams = tc._lagrange_coeffs_at_zero([i + 1 for i, _ in items])
+    master = sum(lam * x for (_, x), lam in zip(items, lams)) % tc.R
+    return tc.Signature(c.g2_mul(c.hash_g2(nonce), master)).parity()
