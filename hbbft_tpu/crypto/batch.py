@@ -177,8 +177,9 @@ def batch_tpke_decrypt(pks, cts, secret_shares):
         raise ValueError(f"need {t + 1} shares, got {len(items)}")
     if not cts:
         return []
-    lams = tc._lagrange_coeffs_at_zero([i + 1 for i, _ in items])
-    master = sum(lam * sk.scalar for (_, sk), lam in zip(items, lams)) % tc.R
+    master = tc.master_secret_from_shares(
+        (i, sk.scalar) for i, sk in items
+    )
     if _device_worthwhile(len(cts), DEVICE_DECRYPT_MIN_BATCH):
         masks = _CACHE.g1_mul_batch(
             [ct.u for ct in cts], [master] * len(cts)

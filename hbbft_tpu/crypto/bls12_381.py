@@ -837,7 +837,9 @@ def g1_from_bytes(data: bytes):
     # Subgroup check: on-curve is not enough — cofactor-torsion components
     # survive pairing-based verification (killed by the final exponentiation)
     # but corrupt Lagrange combination of "verified" shares.
-    if _g1_mul_nat(pt, R) is not None:
+    # [R]·P computed as [R−1]·P + P so the R−1 < r half rides the native
+    # fast path (a 255-bit pure-Python ladder costs ~4 ms per point).
+    if g1_add(g1_mul(pt, R - 1), pt) is not None:
         raise ValueError("G1 point not in the r-order subgroup")
     return pt
 
@@ -864,6 +866,7 @@ def g2_from_bytes(data: bytes):
     pt = ((vals[0], vals[1]), (vals[2], vals[3]), FP2_ONE)
     if not g2_is_on_curve(pt):
         raise ValueError("invalid G2 point")
-    if g2_mul(pt, R, mod_r=False) is not None:
+    # [R]·P as [R−1]·P + P — native fast path, as in g1_from_bytes
+    if g2_add(g2_mul(pt, R - 1), pt) is not None:
         raise ValueError("G2 point not in the r-order subgroup")
     return pt

@@ -50,6 +50,19 @@ def _lagrange_coeffs_at_zero(xs: Sequence[int]) -> List[int]:
     return coeffs
 
 
+def master_secret_from_shares(shares) -> int:
+    """f(0) interpolated from (index, scalar) share pairs.
+
+    The god-view fold used by the batched simulator: combining shares of a
+    common base point Lagrange-in-the-exponent equals one scalar-mul by
+    this master secret.  Caller passes exactly the t+1 shares it would
+    hand to ``combine_signatures``/``decrypt`` (same index convention:
+    evaluation points are index+1)."""
+    items = sorted(shares)
+    lams = _lagrange_coeffs_at_zero([i + 1 for i, _ in items])
+    return sum(lam * x for (_, x), lam in zip(items, lams)) % R
+
+
 def _kdf_stream(seed: bytes, length: int) -> bytes:
     out = b""
     ctr = 0
@@ -374,6 +387,8 @@ class Commitment:
 
     def evaluate(self, x: int):
         """Π points[k]^{x^k} — the commitment to poly(x)."""
+        if x % R == 0:  # Horner collapses to the constant term
+            return self.points[0]
         acc = None
         for pt in reversed(self.points):
             acc = c.g1_add(c.g1_mul(acc, x) if acc is not None else None, pt)
@@ -487,6 +502,8 @@ class BivarCommitment:
         return acc
 
     def row(self, x: int) -> Commitment:
+        if x % R == 0:  # x^i vanishes for i > 0
+            return Commitment(list(self.points[0]))
         out = []
         for j in range(self.degree_ + 1):
             acc = None

@@ -293,18 +293,39 @@ def coin_for(netinfo_map, session_id: bytes, proposer_id, epoch: int) -> bool:
         + repr(proposer_id).encode()
         + struct.pack(">Q", epoch)
     )
+    master = _master_scalar(netinfo_map)
+    return tc.Signature(c.g2_mul(c.hash_g2(nonce), master)).parity()
+
+
+# id(pks) → (pks, master).  The strong reference to the PublicKeySet keeps
+# its id from being recycled while the entry lives (an id()-keyed cache
+# without it could serve another network's secret after GC address reuse);
+# bounded so long-running multi-network processes don't grow it forever.
+_MASTER_CACHE: Dict[int, tuple] = {}
+_MASTER_CACHE_MAX = 64
+
+
+def _master_scalar(netinfo_map) -> int:
+    """f(0) interpolated from t+1 secret shares; cached per PublicKeySet
+    (the O(t²) Lagrange-coefficient computation would otherwise repeat for
+    every one of the N coin instances)."""
+    from hbbft_tpu.crypto import tc
+
     infos = list(netinfo_map.values())
     pks = infos[0].public_key_set()
+    hit = _MASTER_CACHE.get(id(pks))
+    if hit is not None and hit[0] is pks:
+        return hit[1]
     t = pks.threshold()
     ids = sorted(netinfo_map.keys(), key=repr)
-    items = [
+    master = tc.master_secret_from_shares(
         (
             netinfo_map[nid].node_index(nid),
             netinfo_map[nid].secret_key_share().scalar,
         )
         for nid in ids[: t + 1]
-    ]
-    items.sort()
-    lams = tc._lagrange_coeffs_at_zero([i + 1 for i, _ in items])
-    master = sum(lam * x for (_, x), lam in zip(items, lams)) % tc.R
-    return tc.Signature(c.g2_mul(c.hash_g2(nonce), master)).parity()
+    )
+    if len(_MASTER_CACHE) >= _MASTER_CACHE_MAX:
+        _MASTER_CACHE.clear()
+    _MASTER_CACHE[id(pks)] = (pks, master)
+    return master
