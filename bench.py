@@ -17,8 +17,10 @@ Configs (BASELINE.md):
   hb-epoch  full batched HoneyBadger epoch (TPKE → RBC → ABA → decrypt)
             vs the object-mode simulator (config-1 shape at N=16) — the
             headline metric.
-  hb-epoch64  the same full epoch at N=64 f=21 (batched share production
-            + Lagrange combine); host baseline extrapolated from N=16.
+  hb-epoch64 / hb-epoch1024 / hb-epoch4096
+            the same full epoch at N=64 / 1024 / 4096 (master-scalar
+            decrypt fold); host baseline extrapolated from N=16; the
+            4096 config (BASELINE config-5 shape) is explicit-only.
   acs1024   BASELINE config 4: full ACS at N=1024 (GF(2^16) coder).
   rbc-round one full batched RBC round (N=64) vs object mode.
   rbc64     N=64 f=21 RBC shard pipeline: RS encode + Merkle build,
@@ -467,11 +469,11 @@ def bench_hb_epoch(n: int = 16, tx_bytes: int = 256):
     }
 
 
-def bench_hb_epoch64(n: int = 64, tx_bytes: int = 256):
-    """A FULL TPKE HoneyBadger epoch at N=64 (f=21) — encryption, batched
-    ACS, threshold coins, and master-scalar-folded decryption of all
-    accepted ciphertexts.  Host baseline extrapolated from the N=16
-    object-mode epoch (message count scales ~N³)."""
+def _bench_hb_epoch_large(n: int, tx_bytes: int, iters: int, tag: str):
+    """A FULL TPKE HoneyBadger epoch at scale — encryption, batched ACS,
+    threshold coins, and master-scalar-folded decryption of all accepted
+    ciphertexts.  Host baseline extrapolated from the N=16 object-mode
+    epoch (message count scales ~N³)."""
     import random
 
     from hbbft_tpu.netinfo import NetworkInfo
@@ -482,16 +484,16 @@ def bench_hb_epoch64(n: int = 64, tx_bytes: int = 256):
     from hbbft_tpu.sim import NetBuilder, NullAdversary
 
     rng = random.Random(23)
-    print(f"# hb-epoch64: generating keys for N={n}…", file=sys.stderr)
+    print(f"# {tag}: generating keys for N={n}…", file=sys.stderr)
     infos = NetworkInfo.generate_map(list(range(n)), rng)
     contribs = {
         i: bytes(rng.randrange(256) for _ in range(tx_bytes)) for i in range(n)
     }
-    hb = BatchedHoneyBadgerEpoch(infos, session_id=b"bench64")
+    hb = BatchedHoneyBadgerEpoch(infos, session_id=tag.encode())
     batch0, _ = hb.run(contribs, random.Random(1), encrypt=True)  # compile
     assert batch0 == contribs
     times = []
-    for i in range(3):
+    for i in range(iters):
         t0 = time.perf_counter()
         batch, _ = hb.run(contribs, random.Random(2 + i), encrypt=True)
         times.append(time.perf_counter() - t0)
@@ -504,7 +506,7 @@ def bench_hb_epoch64(n: int = 64, tx_bytes: int = 256):
     s_contribs = {i: contribs[i] for i in range(small)}
     net = NetBuilder(list(range(small))).adversary(NullAdversary()).using_step(
         lambda nid: HoneyBadger.builder(s_infos[nid])
-        .session_id(b"bench64")
+        .session_id(tag.encode())
         .encryption_schedule(EncryptionSchedule.always())
         .rng(random.Random(200 + nid))
         .build()
@@ -521,7 +523,7 @@ def bench_hb_epoch64(n: int = 64, tx_bytes: int = 256):
     t_host_est = per_msg * est_msgs
 
     return {
-        "metric": "hb_epoch64_batched",
+        "metric": f"hb_epoch{n}_batched",
         "value": round(1.0 / t_dev, 3),
         "unit": "epochs/s",
         "vs_baseline": round(t_host_est / t_dev, 1),
@@ -531,6 +533,24 @@ def bench_hb_epoch64(n: int = 64, tx_bytes: int = 256):
                      f"({net.messages_delivered} msgs in {t_small:.2f}s)",
         "shape": f"N={n} f={(n - 1) // 3} tx={tx_bytes}B",
     }
+
+
+def bench_hb_epoch64():
+    """Full TPKE HoneyBadger epoch at N=64 f=21."""
+    return _bench_hb_epoch_large(64, 256, iters=3, tag="hb-epoch64")
+
+
+def bench_hb_epoch1024():
+    """Full TPKE HoneyBadger epoch at N=1024 f=341 (BASELINE config 4 with
+    real threshold encryption on top of the ACS)."""
+    return _bench_hb_epoch_large(1024, 64, iters=2, tag="hb-epoch1024")
+
+
+def bench_hb_epoch4096():
+    """Full TPKE HoneyBadger epoch at the BASELINE config-5 shape
+    (N=4096 f=1365).  ~3 min first-run compile and ~40 s per epoch —
+    excluded from --config all; run explicitly."""
+    return _bench_hb_epoch_large(4096, 64, iters=1, tag="hb-epoch4096")
 
 
 def bench_acs1024(n: int = 1024):
@@ -593,6 +613,8 @@ def bench_acs1024(n: int = 1024):
 CONFIGS = {
     "hb-epoch": bench_hb_epoch,
     "hb-epoch64": bench_hb_epoch64,
+    "hb-epoch1024": bench_hb_epoch1024,
+    "hb-epoch4096": bench_hb_epoch4096,
     "acs1024": bench_acs1024,
     "rbc-round": bench_rbc_round,
     "rbc64": bench_rbc64,
@@ -616,7 +638,14 @@ def main(argv=None):
     device = jax.devices()[0]
     print(f"# device: {device.platform} {device.device_kind}", file=sys.stderr)
 
-    names = list(CONFIGS) if args.config == "all" else [args.config]
+    # first-run compile + key generation for the N=4096 config runs into
+    # minutes — kept out of the driver's timed "all" pass
+    explicit_only = {"hb-epoch4096"}
+    names = (
+        [k for k in CONFIGS if k not in explicit_only]
+        if args.config == "all"
+        else [args.config]
+    )
     results = []
     for name in names:
         try:

@@ -311,8 +311,7 @@ def _master_scalar(netinfo_map) -> int:
     every one of the N coin instances)."""
     from hbbft_tpu.crypto import tc
 
-    infos = list(netinfo_map.values())
-    pks = infos[0].public_key_set()
+    pks = next(iter(netinfo_map.values())).public_key_set()
     hit = _MASTER_CACHE.get(id(pks))
     if hit is not None and hit[0] is pks:
         return hit[1]
