@@ -121,9 +121,16 @@ class BatchedHoneyBadgerEpoch:
         self.acs = BatchedAcs(self.n, self.f)
 
     def run(self, contributions: Dict, rng, encrypt: bool = True,
-            **rbc_kwargs):
+            session_suffix: bytes = b"", **rbc_kwargs):
         """contributions: {node_id: bytes}.  Returns (batch, detail): the
-        agreed {node_id: contribution} map plus the ACS detail arrays."""
+        agreed {node_id: contribution} map plus the ACS detail arrays.
+
+        ``session_suffix`` namespaces the coin nonces of this run — callers
+        executing several epochs with one instance (e.g. the batched QHB
+        driver) pass a per-epoch suffix, mirroring the object-mode
+        HoneyBadger's ``session_id + "/hb-epoch/" + epoch`` subset naming,
+        so coin values never repeat across epochs.  Host-side only: no
+        recompilation."""
         from hbbft_tpu.crypto import tc
 
         info0 = self.netinfo_map[self.ids[0]]
@@ -140,10 +147,10 @@ class BatchedHoneyBadgerEpoch:
                 cts.append(None)
                 payloads.append(contrib)
 
+        session = self.session_id + session_suffix
+
         def coin_fn(p, e):
-            return coin_for(
-                self.netinfo_map, self.session_id, self.ids[p], e
-            )
+            return coin_for(self.netinfo_map, session, self.ids[p], e)
 
         out = self.acs.run(payloads, coin_fn=coin_fn, **rbc_kwargs)
         accepted = out["accepted"]

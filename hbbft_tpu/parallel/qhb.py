@@ -1,0 +1,88 @@
+"""Multi-epoch transaction queueing over the batched HoneyBadger epoch.
+
+The array-mode counterpart of :mod:`hbbft_tpu.protocols.queueing_honey_badger`
+(reference: ``src/queueing_honey_badger/`` + ``src/transaction_queue.rs``):
+per-node transaction queues, a random ``batch_size`` sample proposed each
+epoch (sampling keeps different nodes' proposals mostly disjoint), committed
+transactions removed everywhere, leftovers re-proposed — with every epoch
+executed as one :class:`~hbbft_tpu.parallel.acs.BatchedHoneyBadgerEpoch`
+(TPKE encrypt → batched ACS → master-scalar decrypt) instead of an
+object-mode message pump.  This is the scenario the reference's
+``examples/simulation.rs`` benchmarks; ``examples/simulation.py --batched``
+drives it.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, Dict, List, Optional
+
+from hbbft_tpu.parallel.acs import BatchedHoneyBadgerEpoch
+from hbbft_tpu.protocols.queueing_honey_badger import (
+    TransactionQueue,
+    _de_txs,
+    _ser_txs,
+)
+
+
+class BatchedQueueingHoneyBadger:
+    """Epoch driver: queues + batched epochs until the ledger drains."""
+
+    def __init__(self, netinfo_map: Dict, batch_size: int = 100,
+                 session_id: bytes = b"batched-qhb", encrypt: bool = True):
+        self.ids = sorted(netinfo_map.keys(), key=repr)
+        self.hb = BatchedHoneyBadgerEpoch(netinfo_map, session_id=session_id)
+        self.batch_size = batch_size
+        self.encrypt = encrypt
+        self.queues = {nid: TransactionQueue() for nid in self.ids}
+        self.committed: List[bytes] = []  # network commit order, once each
+        self._seen = set()
+        self.epoch = 0
+
+    def push(self, node_id, tx: bytes) -> None:
+        """Inject a transaction at one node (``Input::User`` analog)."""
+        self.queues[node_id].extend([tx])
+
+    def pending(self) -> int:
+        return sum(len(q) for q in self.queues.values())
+
+    def run_epoch(self, rng) -> List[bytes]:
+        """One full epoch: sample proposals, run the batched HB epoch,
+        commit new transactions exactly once, drop them from every queue.
+        Returns the transactions newly committed this epoch."""
+        contribs = {
+            nid: _ser_txs(self.queues[nid].choose(rng, self.batch_size))
+            for nid in self.ids
+        }
+        # per-epoch coin namespace (the object-mode analog: each epoch is a
+        # fresh Subset under session_id + "/hb-epoch/" + epoch)
+        batch, _ = self.hb.run(
+            contribs, rng, encrypt=self.encrypt,
+            session_suffix=struct.pack(">Q", self.epoch),
+        )
+        new: List[bytes] = []
+        epoch_txs: List[bytes] = []
+        for nid in sorted(batch.keys(), key=repr):
+            for tx in _de_txs(batch[nid]):
+                epoch_txs.append(tx)
+                if tx not in self._seen:
+                    self._seen.add(tx)
+                    new.append(tx)
+        for q in self.queues.values():
+            q.remove_multiple(epoch_txs)
+        self.committed.extend(new)
+        self.epoch += 1
+        return new
+
+    def run_to_empty(self, rng, max_epochs: int = 64,
+                     on_epoch: Optional[Callable] = None) -> int:
+        """Run epochs until every injected transaction committed; returns
+        the epoch count.  ``on_epoch(epoch, new_txs)`` fires after each."""
+        start = self.epoch
+        while self.pending() > 0:
+            if self.epoch - start >= max_epochs:
+                raise RuntimeError("transactions not drained")
+            new = self.run_epoch(rng)
+            if on_epoch is not None:
+                on_epoch(self.epoch, new)
+        return self.epoch - start

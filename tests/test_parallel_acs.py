@@ -151,3 +151,25 @@ def test_aba_fast_path_matches_masked_path():
         if bool(np.asarray(st_f["decided"]).all()):
             break
     assert bool(np.asarray(st_f["decided"]).all())
+
+
+def test_batched_qhb_drains_queue_commit_once():
+    """Multi-epoch transaction queueing over batched epochs: every injected
+    tx commits exactly once, leftovers re-propose, queues drain."""
+    import random
+
+    from hbbft_tpu.netinfo import NetworkInfo
+    from hbbft_tpu.parallel.qhb import BatchedQueueingHoneyBadger
+
+    rng = random.Random(41)
+    n = 4
+    infos = NetworkInfo.generate_map(list(range(n)), rng)
+    qhb = BatchedQueueingHoneyBadger(infos, batch_size=3, session_id=b"qhb-t")
+    txs = [b"tx-%02d" % i for i in range(20)]
+    for i, tx in enumerate(txs):
+        qhb.push(i % n, tx)
+
+    epochs = qhb.run_to_empty(rng)
+    assert epochs >= 2  # batch_size 3 × 4 nodes < 20 txs → several epochs
+    assert sorted(qhb.committed) == sorted(txs)  # exactly once each
+    assert qhb.pending() == 0
