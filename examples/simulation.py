@@ -140,7 +140,13 @@ def run_batched(args, infos, rng) -> None:
     from hbbft_tpu.parallel.qhb import BatchedQueueingHoneyBadger
 
     n = args.nodes
-    qhb = BatchedQueueingHoneyBadger(infos, batch_size=args.batch_size)
+    cost = CostModel(
+        bandwidth_bps=args.bandwidth_gbps * 1e9,
+        cpu_lag_s=args.cpu_lag_us * 1e-6,
+    )
+    qhb = BatchedQueueingHoneyBadger(
+        infos, batch_size=args.batch_size, cost_model=cost
+    )
     txs = [
         bytes(rng.randrange(256) for _ in range(args.tx_size))
         for _ in range(args.txs)
@@ -158,12 +164,17 @@ def run_batched(args, infos, rng) -> None:
               f"{now - last[0]:>9.2f}")
         last[0] = now
 
-    qhb.run_to_empty(rng, on_epoch=on_epoch)
+    # enough epochs for the workload even with worst-case sampling overlap
+    max_epochs = max(64, 4 * -(-args.txs // max(n * args.batch_size, 1)))
+    qhb.run_to_empty(rng, max_epochs=max_epochs, on_epoch=on_epoch)
     wall = time.perf_counter() - t0
     assert set(qhb.committed) == set(txs)
     print(f"\ncommitted {len(qhb.committed)}/{len(txs)} txs in "
           f"{qhb.epoch} batched epochs; wall {wall:.2f}s "
           f"({len(qhb.committed) / max(wall, 1e-9):.0f} tx/s incl. compile)")
+    print(f"virtual time {qhb.virtual_time * 1e3:.3f} ms "
+          f"({len(qhb.committed) / max(qhb.virtual_time, 1e-12):.0f} "
+          f"tx/s simulated)")
 
 
 if __name__ == "__main__":

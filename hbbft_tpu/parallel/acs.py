@@ -153,6 +153,9 @@ class BatchedHoneyBadgerEpoch:
             return coin_for(self.netinfo_map, session, self.ids[p], e)
 
         out = self.acs.run(payloads, coin_fn=coin_fn, **rbc_kwargs)
+        # what the RBC actually broadcast (ciphertext bytes when encrypting)
+        # — cost models need this, not the plaintext length
+        out["payload_bytes"] = max((len(p) for p in payloads), default=0)
         accepted = out["accepted"]
         delivered = out["delivered"]
         # agreement across correct nodes is asserted by callers/tests; use

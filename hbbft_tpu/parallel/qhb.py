@@ -29,11 +29,14 @@ class BatchedQueueingHoneyBadger:
     """Epoch driver: queues + batched epochs until the ledger drains."""
 
     def __init__(self, netinfo_map: Dict, batch_size: int = 100,
-                 session_id: bytes = b"batched-qhb", encrypt: bool = True):
-        self.ids = sorted(netinfo_map.keys(), key=repr)
+                 session_id: bytes = b"batched-qhb", encrypt: bool = True,
+                 cost_model=None):
         self.hb = BatchedHoneyBadgerEpoch(netinfo_map, session_id=session_id)
+        self.ids = self.hb.ids
         self.batch_size = batch_size
         self.encrypt = encrypt
+        self.cost_model = cost_model  # optional sim.CostModel → virtual clock
+        self.virtual_time = 0.0
         self.queues = {nid: TransactionQueue() for nid in self.ids}
         self.committed: List[bytes] = []  # network commit order, once each
         self._seen = set()
@@ -56,10 +59,16 @@ class BatchedQueueingHoneyBadger:
         }
         # per-epoch coin namespace (the object-mode analog: each epoch is a
         # fresh Subset under session_id + "/hb-epoch/" + epoch)
-        batch, _ = self.hb.run(
+        batch, detail = self.hb.run(
             contribs, rng, encrypt=self.encrypt,
             session_suffix=struct.pack(">Q", self.epoch),
         )
+        if self.cost_model is not None:
+            self.virtual_time += self.cost_model.batched_epoch_estimate(
+                self.hb.n, self.hb.f,
+                int(detail["payload_bytes"]),  # ciphertext bytes on the wire
+                int(detail["epochs"]),
+            )
         new: List[bytes] = []
         epoch_txs: List[bytes] = []
         for nid in sorted(batch.keys(), key=repr):

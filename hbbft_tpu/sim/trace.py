@@ -80,6 +80,39 @@ class CostModel:
     def charge(self, wire_bytes: int) -> float:
         return self.cpu_lag_s + 8.0 * wire_bytes / self.bandwidth_bps
 
+    def batched_epoch_estimate(
+        self, n: int, f: int, payload_bytes: int, aba_epochs: int
+    ) -> float:
+        """Virtual seconds for ONE bulk-synchronous HoneyBadger epoch.
+
+        The batched simulator executes a whole communication round at once,
+        so instead of per-crank charges it accrues the analytic PER-RECEIVER
+        load (nodes receive in parallel; the epoch's virtual duration is one
+        node's sequential receive work under this hardware model).  Counts
+        per receiver, with N RBC instances and shard size B ≈ payload/k:
+
+        - Value: N shards+proofs (one per instance addressed to us);
+        - Echo: N instances × N sources, shard+proof each;
+        - Ready: N × N digests;
+        - per ABA epoch: N instances × N sources × (BVal+Aux+Conf ≈ 3
+          one-byte votes) and, on coin epochs, N×N 96-byte G2 shares.
+        """
+        k = max(n - 2 * f, 1)
+        shard = max(2, -(-(4 + payload_bytes) // k))
+        depth = max(1, (n - 1).bit_length())
+        proof = 32 * (depth + 1) + 16
+        value_b = n * (shard + proof)
+        echo_b = n * n * (shard + proof)
+        ready_b = n * n * 40
+        votes_b = aba_epochs * n * n * 3 * 8
+        coin_b = max(aba_epochs // 3, 1) * n * n * 96
+        msgs = (
+            n + 2 * n * n + aba_epochs * n * n * 3
+            + max(aba_epochs // 3, 1) * n * n
+        )
+        total_b = value_b + echo_b + ready_b + votes_b + coin_b
+        return msgs * self.cpu_lag_s + 8.0 * total_b / self.bandwidth_bps
+
 
 def wire_size(payload: Any) -> int:
     """Canonical wire size of a protocol message (0 if not encodable)."""

@@ -152,3 +152,18 @@ def test_builders_mirror_reference_knobs():
         .build()
     )
     assert qhb.batch_size == 33 and qhb.dhb is dhb
+
+
+def test_batched_epoch_cost_estimate_scales():
+    """The analytic bulk-synchronous epoch estimate behaves like the
+    hardware model: more nodes / epochs / bytes / lag ⇒ more virtual time."""
+    from hbbft_tpu.sim import CostModel
+
+    cm = CostModel(bandwidth_bps=1e9, cpu_lag_s=1e-5)
+    base = cm.batched_epoch_estimate(16, 5, 256, aba_epochs=3)
+    assert base > 0
+    assert cm.batched_epoch_estimate(64, 21, 256, 3) > base
+    assert cm.batched_epoch_estimate(16, 5, 256, 9) > base
+    assert cm.batched_epoch_estimate(16, 5, 4096, 3) > base
+    slow = CostModel(bandwidth_bps=1e6, cpu_lag_s=1e-5)
+    assert slow.batched_epoch_estimate(16, 5, 256, 3) > base

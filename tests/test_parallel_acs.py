@@ -173,3 +173,22 @@ def test_batched_qhb_drains_queue_commit_once():
     assert epochs >= 2  # batch_size 3 × 4 nodes < 20 txs → several epochs
     assert sorted(qhb.committed) == sorted(txs)  # exactly once each
     assert qhb.pending() == 0
+
+
+def test_batched_epoch_deterministic():
+    """Same seeds ⇒ bit-identical batched HB epoch results (the batched
+    analog of the object-mode same-seed ⇒ identical-trace test)."""
+    import random
+
+    from hbbft_tpu.netinfo import NetworkInfo
+    from hbbft_tpu.parallel.acs import BatchedHoneyBadgerEpoch
+
+    infos = NetworkInfo.generate_map(list(range(4)), random.Random(11))
+    contribs = {i: b"det-%d" % i * 3 for i in range(4)}
+    hb = BatchedHoneyBadgerEpoch(infos, session_id=b"det")
+
+    b1, d1 = hb.run(contribs, random.Random(5), encrypt=True)
+    b2, d2 = hb.run(contribs, random.Random(5), encrypt=True)
+    assert b1 == b2 == contribs
+    for k in ("accepted", "delivered", "data"):
+        np.testing.assert_array_equal(np.asarray(d1[k]), np.asarray(d2[k]))
