@@ -629,15 +629,6 @@ def main(argv=None):
     ap.add_argument("--config", choices=[*CONFIGS, "all"], default="all")
     args = ap.parse_args(argv)
 
-    import jax
-
-    from hbbft_tpu.util import enable_compilation_cache
-
-    enable_compilation_cache()
-
-    device = jax.devices()[0]
-    print(f"# device: {device.platform} {device.device_kind}", file=sys.stderr)
-
     # first-run compile + key generation for the N=4096 config runs into
     # minutes — kept out of the driver's timed "all" pass
     explicit_only = {"hb-epoch4096"}
@@ -647,35 +638,79 @@ def main(argv=None):
         else [args.config]
     )
     results = []
-    for name in names:
-        try:
-            r = CONFIGS[name]()
-        except Exception as exc:  # one broken config must not kill the line
-            print(f"# {name} FAILED: {exc!r}", file=sys.stderr)
-            continue
-        r["device"] = device.device_kind
-        print(f"# {json.dumps(r)}", file=sys.stderr)
-        results.append(r)
-    if not results:
-        print(json.dumps({"metric": "none", "value": 0, "unit": "n/a",
-                          "vs_baseline": 0}))
-        return
+    failed = []
+    emitted = False
+    interrupted = None
 
-    # Headline = the FIRST config (the full batched HB epoch under
-    # --config all); detail rows carry the rest.
-    head = results[0]
-    line = {
-        "metric": head["metric"],
-        "value": head["value"],
-        "unit": head["unit"],
-        "vs_baseline": head["vs_baseline"],
-        "device": head["device"],
-        "detail": [
-            {k: r[k] for k in ("metric", "value", "unit", "vs_baseline")}
-            for r in results
-        ],
-    }
-    print(json.dumps(line))
+    def emit_line():
+        # Exactly ONE JSON line, whatever subset of configs completed.
+        # Headline = the FIRST completed config (the full batched HB epoch
+        # under --config all); detail rows carry the rest; partial/failed
+        # runs are marked so a driver timeout can't masquerade as a full
+        # successful pass.
+        nonlocal emitted
+        if emitted:
+            return
+        emitted = True
+        if not results:
+            line = {"metric": "none", "value": 0, "unit": "n/a",
+                    "vs_baseline": 0}
+        else:
+            head = results[0]
+            line = {
+                "metric": head["metric"],
+                "value": head["value"],
+                "unit": head["unit"],
+                "vs_baseline": head["vs_baseline"],
+                "device": head["device"],
+                "detail": [
+                    {k: r[k]
+                     for k in ("metric", "value", "unit", "vs_baseline")}
+                    for r in results
+                ],
+            }
+        if failed:
+            line["configs_failed"] = failed
+        if interrupted is not None:
+            line["interrupted"] = interrupted
+        print(json.dumps(line), flush=True)
+
+    def on_term(signum, frame):
+        # a driver timeout must not erase the configs that DID finish;
+        # no I/O here (buffered streams are not reentrant) — just record
+        # and unwind to the finally below
+        nonlocal interrupted
+        interrupted = signum
+        raise SystemExit(0)
+
+    import signal
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, on_term)
+
+    try:
+        import jax
+
+        from hbbft_tpu.util import enable_compilation_cache
+
+        enable_compilation_cache()
+
+        device = jax.devices()[0]
+        print(f"# device: {device.platform} {device.device_kind}",
+              file=sys.stderr)
+
+        for name in names:
+            try:
+                r = CONFIGS[name]()
+            except Exception as exc:  # a broken config must not kill the line
+                print(f"# {name} FAILED: {exc!r}", file=sys.stderr)
+                failed.append(name)
+                continue
+            r["device"] = device.device_kind
+            print(f"# {json.dumps(r)}", file=sys.stderr)
+            results.append(r)
+    finally:
+        emit_line()
 
 
 if __name__ == "__main__":
