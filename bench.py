@@ -610,18 +610,20 @@ def bench_acs1024(n: int = 1024):
     }
 
 
+# Ordered so an interrupted driver run keeps the BASELINE configs: the
+# headline epoch (config 1 shape), then configs 2/3/4, then the rest.
 CONFIGS = {
     "hb-epoch": bench_hb_epoch,
-    "hb-epoch64": bench_hb_epoch64,
-    "hb-epoch1024": bench_hb_epoch1024,
-    "hb-epoch4096": bench_hb_epoch4096,
-    "acs1024": bench_acs1024,
-    "rbc-round": bench_rbc_round,
     "rbc64": bench_rbc64,
     "rbc64-reconstruct": bench_rbc64_reconstruct,
-    "sha3": bench_sha3,
     "coin256": bench_coin256,
+    "acs1024": bench_acs1024,
+    "hb-epoch1024": bench_hb_epoch1024,
+    "hb-epoch64": bench_hb_epoch64,
+    "rbc-round": bench_rbc_round,
+    "sha3": bench_sha3,
     "dkg256": bench_dkg256,
+    "hb-epoch4096": bench_hb_epoch4096,
 }
 
 def main(argv=None):
@@ -641,6 +643,7 @@ def main(argv=None):
     failed = []
     emitted = False
     interrupted = None
+    error = None
 
     def emit_line():
         # Exactly ONE JSON line, whatever subset of configs completed.
@@ -673,15 +676,18 @@ def main(argv=None):
             line["configs_failed"] = failed
         if interrupted is not None:
             line["interrupted"] = interrupted
+        if error is not None:
+            line["error"] = error
         print(json.dumps(line), flush=True)
 
     def on_term(signum, frame):
         # a driver timeout must not erase the configs that DID finish;
         # no I/O here (buffered streams are not reentrant) — just record
-        # and unwind to the finally below
+        # and unwind to the finally below; conventional 128+signum exit
+        # status so rc-based consumers see the interruption
         nonlocal interrupted
         interrupted = signum
-        raise SystemExit(0)
+        raise SystemExit(128 + signum)
 
     import signal
 
@@ -709,6 +715,13 @@ def main(argv=None):
             r["device"] = device.device_kind
             print(f"# {json.dumps(r)}", file=sys.stderr)
             results.append(r)
+    except BaseException as exc:
+        # a harness/setup crash must be distinguishable from a clean
+        # zero-result run in the emitted line; the re-raise keeps the
+        # nonzero exit status
+        if not isinstance(exc, SystemExit):
+            error = repr(exc)
+        raise
     finally:
         emit_line()
 

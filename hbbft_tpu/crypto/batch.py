@@ -151,9 +151,9 @@ _CACHE = _MsmCache()
 
 # crossover for the decrypt batch: with the master-scalar fold the cost is
 # ONE scalar-mul per ciphertext, so the device ladder only pays off once the
-# ciphertext count alone is large (C++ oracle ≈ 0.5 ms/mul → host beats the
-# ~2 s ladder launch until A is in the thousands)
-DEVICE_DECRYPT_MIN_BATCH = 4096
+# ciphertext count alone is large (C++ oracle ≈ 0.44 ms/mul: A=4096 is
+# 1.8 s on host vs ~2.4 s for the ladder launch — host still wins there)
+DEVICE_DECRYPT_MIN_BATCH = 8192
 
 
 def batch_tpke_decrypt(pks, cts, secret_shares):
