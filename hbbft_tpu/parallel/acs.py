@@ -30,21 +30,38 @@ from hbbft_tpu.parallel.rbc import BatchedRbc, frame_values, unframe_value
 class BatchedAcs:
     """One ACS instance over an (n, f) network: N proposers, N receivers."""
 
-    def __init__(self, n: int, f: int):
+    def __init__(self, n: int, f: int, mesh=None):
         self.n = n
         self.f = f
+        self.mesh = mesh
         self.rbc = BatchedRbc(n, f)
         self.aba = BatchedAba(n, f)
         # jit once per instance — a fresh jax.jit per run() call would
         # recompile the whole pipeline every epoch
         import jax
 
-        # the large-N RBC path orchestrates host steps internally and must
-        # not be wrapped in jit
-        self._rbc_run = (
-            self.rbc.run if self.rbc.large else jax.jit(self.rbc.run)
-        )
-        self._aba_step = jax.jit(self.aba.epoch_step)
+        if mesh is not None:
+            # the whole epoch rides the device mesh: RBC fan-out and ABA
+            # exchanges become ICI/DCN collectives (SURVEY §2.3 comm backend)
+            from hbbft_tpu.parallel.mesh import (
+                make_sharded_aba_step,
+                make_sharded_rbc_run,
+            )
+
+            assert not self.rbc.large, (
+                "mesh sharding requires the jitted RBC path (n <= the "
+                "large-N threshold)"
+            )
+            assert n % mesh.devices.size == 0, (n, mesh.devices.size)
+            self._rbc_run = make_sharded_rbc_run(self.rbc, mesh)
+            self._aba_step = make_sharded_aba_step(self.aba, mesh)
+        else:
+            # the large-N RBC path orchestrates host steps internally and
+            # must not be wrapped in jit
+            self._rbc_run = (
+                self.rbc.run if self.rbc.large else jax.jit(self.rbc.run)
+            )
+            self._aba_step = jax.jit(self.aba.epoch_step)
 
     def run(
         self,
@@ -110,7 +127,8 @@ class BatchedHoneyBadgerEpoch:
     ``HoneyBadger`` in tests.
     """
 
-    def __init__(self, netinfo_map: Dict, session_id: bytes = b"batched-hb"):
+    def __init__(self, netinfo_map: Dict, session_id: bytes = b"batched-hb",
+                 mesh=None):
         ids = sorted(netinfo_map.keys(), key=repr)
         self.ids = ids
         self.netinfo_map = netinfo_map
@@ -118,7 +136,7 @@ class BatchedHoneyBadgerEpoch:
         self.n = info0.num_nodes()
         self.f = info0.num_faulty()
         self.session_id = session_id
-        self.acs = BatchedAcs(self.n, self.f)
+        self.acs = BatchedAcs(self.n, self.f, mesh=mesh)
 
     def run(self, contributions: Dict, rng, encrypt: bool = True,
             session_suffix: bytes = b"", **rbc_kwargs):

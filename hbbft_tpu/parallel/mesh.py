@@ -50,15 +50,14 @@ def _flat_device_index(axes):
     return idx
 
 
-def sharded_rbc_run(rbc: BatchedRbc, mesh, data, codeword_tamper=None,
-                    value_tamper=None, value_mask=None, echo_mask=None,
-                    ready_mask=None):
-    """Full batched RBC round with the node axis sharded over ``mesh``.
+def make_sharded_rbc_run(rbc: BatchedRbc, mesh):
+    """Build ONE jitted sharded-RBC round for ``(rbc, mesh)``.
 
     ``mesh`` may have one axis (single-host chips over ICI) or two
-    (hosts × chips — DCN × ICI); ``data``: uint8 (P, k, B) with
-    P == rbc.n divisible by the total device count.  Masks/tampers as in
-    :meth:`BatchedRbc.run` (replicated).
+    (hosts × chips — DCN × ICI).  The returned callable has the signature
+    of :func:`sharded_rbc_run` minus the leading ``rbc, mesh`` and reuses
+    its compiled executable across calls — epoch drivers must build it once
+    (a fresh ``jax.jit`` per epoch would re-trace the whole pipeline).
 
     Returns the same dict as ``BatchedRbc.run`` with per-receiver arrays
     gathered back to full size, so results are directly comparable with the
@@ -74,18 +73,6 @@ def sharded_rbc_run(rbc: BatchedRbc, mesh, data, codeword_tamper=None,
     n_dev = mesh.devices.size
     assert n % n_dev == 0, (n, n_dev)
     per = n // n_dev
-
-    P_, k, B = data.shape
-    if codeword_tamper is None:
-        codeword_tamper = jnp.zeros((P_, n, B), dtype=jnp.uint8)
-    if value_tamper is None:
-        value_tamper = jnp.zeros((P_, n, B), dtype=jnp.uint8)
-    if value_mask is None:
-        value_mask = jnp.ones((P_, n), dtype=bool)
-    if echo_mask is None:
-        echo_mask = jnp.ones((n, n, P_), dtype=bool)
-    if ready_mask is None:
-        ready_mask = jnp.ones((n, n, P_), dtype=bool)
 
     def step(d, cw, vt, vm, em, rm):
         # d: local (per, k, B) — this device's proposers
@@ -123,10 +110,254 @@ def sharded_rbc_run(rbc: BatchedRbc, mesh, data, codeword_tamper=None,
     # check_vma off: the "root" output is replicated by construction (it is
     # an all_gather result) but the checker can't see that through the
     # data-dependent receiver phase.
-    fn = shard_map(
+    fn = jax.jit(shard_map(
         step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False,
-    )
-    return jax.jit(fn)(
-        data, codeword_tamper, value_tamper, value_mask, echo_mask, ready_mask
-    )
+    ))
+
+    def run(data, codeword_tamper=None, value_tamper=None, value_mask=None,
+            echo_mask=None, ready_mask=None):
+        P_, k, B = data.shape
+        if codeword_tamper is None:
+            codeword_tamper = jnp.zeros((P_, n, B), dtype=jnp.uint8)
+        if value_tamper is None:
+            value_tamper = jnp.zeros((P_, n, B), dtype=jnp.uint8)
+        if value_mask is None:
+            value_mask = jnp.ones((P_, n), dtype=bool)
+        if echo_mask is None:
+            echo_mask = jnp.ones((n, n, P_), dtype=bool)
+        if ready_mask is None:
+            ready_mask = jnp.ones((n, n, P_), dtype=bool)
+        return fn(data, codeword_tamper, value_tamper, value_mask,
+                  echo_mask, ready_mask)
+
+    return run
+
+
+def sharded_rbc_run(rbc: BatchedRbc, mesh, data, **kwargs):
+    """One-shot convenience wrapper over :func:`make_sharded_rbc_run`.
+
+    Single calls (tests, the driver dryrun) only; epoch drivers hold on to
+    the maker's callable instead so the compiled executable is reused.
+    """
+    return make_sharded_rbc_run(rbc, mesh)(data, **kwargs)
+
+
+def make_sharded_aba_step(aba, mesh):
+    """A jitted ABA epoch step with node-state rows sharded over ``mesh``.
+
+    Same semantics as :meth:`BatchedAba.epoch_step` (bit-equal — tests
+    assert it): state arrays (N, P) shard their node axis; the BVal/Aux/Conf
+    exchanges become ``all_gather``/``psum`` collectives over the mesh axes
+    (ICI-first on a hierarchical mesh) instead of in-device reductions.
+    Masks, ``coin_bits`` and the epoch counter are replicated.
+
+    Returns ``step(state, coin_bits, bval_mask=None, aux_mask=None,
+    conf_mask=None) -> state``; jit once, call per epoch.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    n, f = aba.n, aba.f
+    axes = tuple(mesh.axis_names)
+    n_dev = mesh.devices.size
+    assert n % n_dev == 0, (n, n_dev)
+    per = n // n_dev
+
+    spec_p = P(axes)
+    spec_r = P()
+    state_specs = {
+        "est": spec_p, "decided": spec_p, "decision": spec_p,
+        "epoch": spec_r,
+    }
+
+    def _psum(x):
+        return jax.lax.psum(x, axes)
+
+    def step_full(state, coin_bits):
+        # local slices: est/decided/decision (per, P)
+        est = state["est"]
+        decided = state["decided"]
+        decision = state["decision"]
+        P_ = est.shape[1]
+
+        active = ~decided
+        val_axis = jnp.stack([~est, est], axis=-1)
+        term_axis = jnp.stack([~decision, decision], axis=-1)
+        sent = jnp.where(decided[..., None], term_axis, val_axis)
+
+        def relay(_, s):
+            cnt = _psum(s.sum(axis=0))  # (P, 2) — identical everywhere
+            return s | (cnt >= (f + 1))[None]
+
+        sent = jax.lax.fori_loop(0, 2, relay, sent)
+        cnt = _psum(sent.sum(axis=0))
+        bin_vals = cnt >= (2 * f + 1)  # (P, 2), shared
+
+        aux_val = jnp.where(decided, decision, bin_vals[None, :, 1])
+        aux_sent = bin_vals.any(axis=-1)[None] | decided
+        aux_v = jnp.stack([~aux_val, aux_val], axis=-1) & aux_sent[..., None]
+        support = _psum((aux_v & bin_vals[None]).any(axis=-1).sum(axis=0))
+        vals = bin_vals & (_psum(aux_v.sum(axis=0)) > 0)
+        sbv_done = support >= (n - f)  # (P,)
+
+        conf = jnp.where(decided[..., None], term_axis, vals[None])
+        viol = (conf & ~bin_vals[None]).any(axis=-1)  # (per, P)
+        sent_conf = sbv_done[None] | decided
+        conf_count = _psum((sent_conf & ~viol).sum(axis=0))
+        conf_done = conf_count >= (n - f)
+
+        m = state["epoch"] % 3
+        coin = jnp.where(
+            m == 0,
+            jnp.ones((P_,), dtype=bool),
+            jnp.where(m == 1, jnp.zeros((P_,), dtype=bool), coin_bits),
+        )
+
+        only_true = vals[:, 1] & ~vals[:, 0]
+        vals_single = only_true | (vals[:, 0] & ~vals[:, 1])
+        vals_val = only_true
+        ready = (conf_done & sbv_done)[None] & active
+        decide_now = ready & (vals_single & (vals_val == coin))[None]
+        new_est = jnp.where(vals_single, vals_val, coin)[None]
+        est = jnp.where(ready, jnp.broadcast_to(new_est, est.shape), est)
+        coin_b = jnp.broadcast_to(coin[None], est.shape)
+        decision = jnp.where(decide_now, coin_b, decision)
+        decided = decided | decide_now
+
+        for v in (False, True):
+            term_cnt = _psum((decided & (decision == v)).sum(axis=0))
+            adopt = active & (term_cnt >= (f + 1))[None] & ~decided
+            decision = jnp.where(adopt, v, decision)
+            decided = decided | adopt
+
+        return {
+            "est": est,
+            "decided": decided,
+            "decision": decision,
+            "epoch": state["epoch"] + 1,
+        }
+
+    def step_masked(state, coin_bits, bval_mask, aux_mask, conf_mask):
+        est = state["est"]
+        decided = state["decided"]
+        decision = state["decision"]
+
+        me = _flat_device_index(axes)
+        base = me * per
+        # receiver slices of the replicated (N_src, N_dst, P) masks
+        bm = jax.lax.dynamic_slice_in_dim(bval_mask, base, per, axis=1)
+        am = jax.lax.dynamic_slice_in_dim(aux_mask, base, per, axis=1)
+        cm = jax.lax.dynamic_slice_in_dim(conf_mask, base, per, axis=1)
+
+        active = ~decided
+        val_axis = jnp.stack([~est, est], axis=-1)
+        term_axis = jnp.stack([~decision, decision], axis=-1)
+        sent = jnp.where(decided[..., None], term_axis, val_axis)  # local
+
+        def relay(_, s):
+            s_full = _gather_nodes(s, axes)  # (N, P, 2)
+            cnt = jnp.einsum(
+                "ipv,ijp->jpv", s_full.astype(jnp.int32),
+                bm.astype(jnp.int32),
+            )  # (per, P, 2) — my receivers
+            return s | (cnt >= (f + 1))
+
+        sent = jax.lax.fori_loop(0, n, relay, sent)
+        sent_full = _gather_nodes(sent, axes)
+        cnt = jnp.einsum(
+            "ipv,ijp->jpv", sent_full.astype(jnp.int32),
+            bm.astype(jnp.int32),
+        )
+        bin_vals = cnt >= (2 * f + 1)  # (per, P, 2)
+
+        aux_val = jnp.where(decided, decision, bin_vals[..., 1])
+        aux_sent = bin_vals.any(axis=-1) | decided
+        aux_v = jnp.stack([~aux_val, aux_val], axis=-1) & aux_sent[..., None]
+        aux_v_full = _gather_nodes(aux_v, axes)  # (N, P, 2)
+        support = jnp.einsum(
+            "ipv,ijp,jpv->jp", aux_v_full.astype(jnp.int32),
+            am.astype(jnp.int32), bin_vals.astype(jnp.int32),
+        )
+        vals = bin_vals & (
+            jnp.einsum(
+                "ipv,ijp->jpv", aux_v_full.astype(jnp.int32),
+                am.astype(jnp.int32),
+            )
+            > 0
+        )
+        sbv_done = support >= (n - f)  # (per, P)
+
+        conf = jnp.where(decided[..., None], term_axis, vals)
+        conf_full = _gather_nodes(conf, axes)  # (N, P, 2)
+        viol = jnp.einsum(
+            "ipv,jpv->ijp", conf_full.astype(jnp.int32),
+            (~bin_vals).astype(jnp.int32),
+        )  # (N senders, per receivers, P)
+        sent_conf_full = _gather_nodes(sbv_done | decided, axes)  # (N, P)
+        conf_count = (
+            (viol == 0) & cm & sent_conf_full[:, None, :]
+        ).sum(axis=0)  # (per, P)
+        conf_done = conf_count >= (n - f)
+
+        m = state["epoch"] % 3
+        P_ = est.shape[1]
+        coin = jnp.where(
+            m == 0,
+            jnp.ones((P_,), dtype=bool),
+            jnp.where(m == 1, jnp.zeros((P_,), dtype=bool), coin_bits),
+        )
+        coin_b = jnp.broadcast_to(coin[None, :], est.shape)
+
+        only_true = vals[..., 1] & ~vals[..., 0]
+        vals_single = only_true | (vals[..., 0] & ~vals[..., 1])
+        vals_val = only_true
+        ready = conf_done & sbv_done & active
+        decide_now = ready & vals_single & (vals_val == coin_b)
+        new_est = jnp.where(vals_single, vals_val, coin_b)
+        est = jnp.where(ready, new_est, est)
+        decision = jnp.where(decide_now, coin_b, decision)
+        decided = decided | decide_now
+
+        for v in (False, True):
+            term_cnt = _psum((decided & (decision == v)).sum(axis=0))
+            adopt = active & (term_cnt >= (f + 1))[None, :] & ~decided
+            decision = jnp.where(adopt, v, decision)
+            decided = decided | adopt
+
+        return {
+            "est": est,
+            "decided": decided,
+            "decision": decision,
+            "epoch": state["epoch"] + 1,
+        }
+
+    fn_full = jax.jit(shard_map(
+        step_full, mesh=mesh,
+        in_specs=(state_specs, spec_r),
+        out_specs=state_specs,
+        check_vma=False,
+    ))
+    fn_masked = jax.jit(shard_map(
+        step_masked, mesh=mesh,
+        in_specs=(state_specs, spec_r, spec_r, spec_r, spec_r),
+        out_specs=state_specs,
+        check_vma=False,
+    ))
+
+    def step(state, coin_bits, bval_mask=None, aux_mask=None, conf_mask=None):
+        if bval_mask is None and aux_mask is None and conf_mask is None:
+            return fn_full(state, coin_bits)
+        import jax.numpy as jnp
+
+        P_ = state["est"].shape[1]
+        eye = jnp.eye(n, dtype=bool)[:, :, None]
+        ones = jnp.ones((n, n, P_), dtype=bool)
+        bm = ones if bval_mask is None else jnp.asarray(bval_mask) | eye
+        am = ones if aux_mask is None else jnp.asarray(aux_mask) | eye
+        cm = ones if conf_mask is None else jnp.asarray(conf_mask) | eye
+        return fn_masked(state, coin_bits, bm, am, cm)
+
+    return step

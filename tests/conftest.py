@@ -24,9 +24,37 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+from hbbft_tpu.util import enable_compilation_cache
+
+# The big fori_loop ladder graphs cost minutes to compile; persist the
+# executables so the suite pays that once per (code, shape), not per run.
+enable_compilation_cache()
+
 import random
 
 import pytest
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--slow", action="store_true", default=False,
+        help="also run tests marked slow (full-width MSM ladders etc.)",
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test, skipped unless --slow is given"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--slow"):
+        return
+    skip = pytest.mark.skip(reason="slow test: run with --slow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
 
 
 @pytest.fixture

@@ -90,34 +90,61 @@ def test_g1_add_complete_cases(g1_batch):
         assert H.g1_eq(got, pts_h[i])
 
 
-def test_g1_msm_ladder_and_tree():
-    """B=2 MSM: exercises the full 255-step ladder AND one device tree-add,
-    with edge scalars, in a single compile."""
+def _g1_msm_case(nbits, scalar_pairs):
+    """MSM parity vs host at a given ladder width: exercises the add/double
+    step and the device tree-add; ladder length only changes the unroll."""
     rng = random.Random(13)
-    cases = [
-        (0, rng.randrange(1, H.R)),
-        (1, H.R - 1),
-        (rng.randrange(1, H.R), rng.randrange(1, H.R)),
-    ]
     fn = jax.jit(lambda p, b: G.msm(G.FP_OPS, p, b))
     base = [H.g1_mul(H.G1_GEN, rng.randrange(1, H.R)) for _ in range(2)]
     pts = tuple(jnp.asarray(c) for c in G.g1_to_device(base))
-    for s0, s1 in cases:
-        bits = jnp.asarray(G.scalars_to_bits([s0, s1]))
+    for s0, s1 in scalar_pairs:
+        bits = jnp.asarray(G.scalars_to_bits([s0, s1], nbits=nbits))
         m = fn(pts, bits)
         expect = H.g1_add(H.g1_mul(base[0], s0), H.g1_mul(base[1], s1))
         assert H.g1_eq(G.g1_from_device(tuple(np.asarray(c) for c in m)), expect)
 
 
-def test_g2_msm_ladder_and_tree():
+def test_g1_msm_ladder_and_tree():
+    """64-bit ladder by default (same per-step machinery as full width;
+    compile is minutes shorter).  Full 255-bit width: --slow."""
+    rng = random.Random(13)
+    _g1_msm_case(64, [
+        (0, rng.randrange(1, 1 << 64)),
+        (1, (1 << 64) - 1),
+        (rng.randrange(1, 1 << 64), rng.randrange(1, 1 << 64)),
+    ])
+
+
+@pytest.mark.slow
+def test_g1_msm_ladder_full_width():
+    rng = random.Random(13)
+    _g1_msm_case(G.R_BITS, [
+        (0, rng.randrange(1, H.R)),
+        (1, H.R - 1),
+        (rng.randrange(1, H.R), rng.randrange(1, H.R)),
+    ])
+
+
+def _g2_msm_case(nbits, s0, s1):
     rng = random.Random(17)
     base = [H.g2_mul(H.G2_GEN, rng.randrange(1, H.R)) for _ in range(2)]
     pts = tuple(tuple(jnp.asarray(x) for x in c) for c in G.g2_to_device(base))
-    s0, s1 = rng.randrange(1, H.R), H.R - 1
-    bits = jnp.asarray(G.scalars_to_bits([s0, s1]))
+    bits = jnp.asarray(G.scalars_to_bits([s0, s1], nbits=nbits))
     m = jax.jit(lambda p, b: G.msm(G.FP2_OPS, p, b))(pts, bits)
     expect = H.g2_add(H.g2_mul(base[0], s0), H.g2_mul(base[1], s1))
     assert H.g2_eq(
         G.g2_from_device(tuple(tuple(np.asarray(x) for x in c) for c in m)),
         expect,
     )
+
+
+def test_g2_msm_ladder_and_tree():
+    rng = random.Random(17)
+    _g2_msm_case(64, rng.randrange(1, 1 << 64), (1 << 64) - 1)
+
+
+@pytest.mark.slow
+def test_g2_msm_ladder_full_width():
+    rng = random.Random(17)
+    _g2_msm_case(G.R_BITS,
+                 rng.randrange(1, H.R), H.R - 1)

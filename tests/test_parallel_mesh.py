@@ -111,3 +111,86 @@ def test_sharded_2d_mesh_matches_single_device(mesh8):
     for key in single:
         np.testing.assert_array_equal(sharded[key], single[key], err_msg=key)
     assert sharded["delivered"].all()
+
+
+# ---------------------------------------------------------------------------
+# Sharded ABA + full sharded HoneyBadger epoch
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_aba_matches_single_device_full_delivery(mesh8):
+    from hbbft_tpu.parallel.aba import BatchedAba
+    from hbbft_tpu.parallel.mesh import make_sharded_aba_step
+
+    n, f = 8, 2
+    aba = BatchedAba(n, f)
+    rng = np.random.default_rng(3)
+    est = jnp.asarray(rng.random((n, n)) < 0.5)
+
+    st_s = aba.init_state(est)
+    st_m = aba.init_state(est)
+    step_s = jax.jit(aba.epoch_step)
+    step_m = make_sharded_aba_step(aba, mesh8)
+    for e in range(9):
+        coins = jnp.asarray(rng.random((n,)) < 0.5)
+        st_s = step_s(st_s, coins)
+        st_m = step_m(st_m, coins)
+        for k in ("est", "decided", "decision"):
+            np.testing.assert_array_equal(
+                np.asarray(st_m[k]), np.asarray(st_s[k]), err_msg=f"{k}@{e}"
+            )
+        if bool(np.asarray(st_s["decided"]).all()):
+            break
+    assert bool(np.asarray(st_s["decided"]).all())
+
+
+def test_sharded_aba_matches_single_device_masked(mesh8):
+    from hbbft_tpu.parallel.aba import BatchedAba
+    from hbbft_tpu.parallel.mesh import make_sharded_aba_step
+
+    n, f = 8, 2
+    aba = BatchedAba(n, f)
+    rng = np.random.default_rng(5)
+    est = jnp.asarray(rng.random((n, n)) < 0.5)
+
+    st_s = aba.init_state(est)
+    st_m = aba.init_state(est)
+    step_s = jax.jit(aba.epoch_step)
+    step_m = make_sharded_aba_step(aba, mesh8)
+    for e in range(12):
+        coins = jnp.asarray(rng.random((n,)) < 0.5)
+        # random delivery drops, self-delivery forced inside the step
+        bm = jnp.asarray(~(rng.random((n, n, n)) < 0.2))
+        am = jnp.asarray(~(rng.random((n, n, n)) < 0.2))
+        cm = jnp.asarray(~(rng.random((n, n, n)) < 0.2))
+        st_s = step_s(st_s, coins, bval_mask=bm, aux_mask=am, conf_mask=cm)
+        st_m = step_m(st_m, coins, bval_mask=bm, aux_mask=am, conf_mask=cm)
+        for k in ("est", "decided", "decision"):
+            np.testing.assert_array_equal(
+                np.asarray(st_m[k]), np.asarray(st_s[k]), err_msg=f"{k}@{e}"
+            )
+
+
+def test_sharded_full_hb_epoch_matches_single_device(mesh8):
+    """The complete epoch — RBC fan-out, ABA epochs, TPKE decrypt — on the
+    8-device mesh, byte-identical Batch to the single-device array path."""
+    import random as pyrandom
+
+    from hbbft_tpu.netinfo import NetworkInfo
+    from hbbft_tpu.parallel.acs import BatchedHoneyBadgerEpoch
+
+    n = 8
+    rng = pyrandom.Random(11)
+    netinfo = NetworkInfo.generate_map(list(range(n)), rng)
+
+    contribs = {i: bytes([i + 1]) * (5 + i) for i in range(n)}
+    single = BatchedHoneyBadgerEpoch(netinfo, session_id=b"mesh-cmp")
+    batch_s, out_s = single.run(dict(contribs), pyrandom.Random(42))
+
+    sharded = BatchedHoneyBadgerEpoch(netinfo, session_id=b"mesh-cmp",
+                                      mesh=mesh8)
+    batch_m, out_m = sharded.run(dict(contribs), pyrandom.Random(42))
+
+    assert batch_m == batch_s
+    np.testing.assert_array_equal(out_m["accepted"], out_s["accepted"])
+    assert out_m["epochs"] == out_s["epochs"]
