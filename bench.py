@@ -531,6 +531,7 @@ def _bench_hb_epoch_large(n: int, tx_bytes: int, iters: int, tag: str):
         "t_host_est_s": round(t_host_est, 1),
         "host_note": f"extrapolated from N={small} object-mode "
                      f"({net.messages_delivered} msgs in {t_small:.2f}s)",
+        "extrapolated": True,
         "shape": f"N={n} f={(n - 1) // 3} tx={tx_bytes}B",
     }
 
@@ -548,8 +549,9 @@ def bench_hb_epoch1024():
 
 def bench_hb_epoch4096():
     """Full TPKE HoneyBadger epoch at the BASELINE config-5 shape
-    (N=4096 f=1365).  ~3 min first-run compile and ~40 s per epoch —
-    excluded from --config all; run explicitly."""
+    (N=4096 f=1365).  ~3 min first-run compile and ~40 s per epoch — runs
+    LAST in --config all so a driver timeout preserves every other config
+    (the emit path marks interrupted runs)."""
     return _bench_hb_epoch_large(4096, 64, iters=1, tag="hb-epoch4096")
 
 
@@ -606,6 +608,7 @@ def bench_acs1024(n: int = 1024):
         "t_host_est_s": round(t_host_est, 1),
         "host_note": f"extrapolated from N={small} object-mode "
                      f"({net.messages_delivered} msgs in {t_small:.2f}s)",
+        "extrapolated": True,
         "shape": f"N={n} f={f}",
     }
 
@@ -631,14 +634,7 @@ def main(argv=None):
     ap.add_argument("--config", choices=[*CONFIGS, "all"], default="all")
     args = ap.parse_args(argv)
 
-    # first-run compile + key generation for the N=4096 config runs into
-    # minutes — kept out of the driver's timed "all" pass
-    explicit_only = {"hb-epoch4096"}
-    names = (
-        [k for k in CONFIGS if k not in explicit_only]
-        if args.config == "all"
-        else [args.config]
-    )
+    names = list(CONFIGS) if args.config == "all" else [args.config]
     results = []
     failed = []
     emitted = False
@@ -667,11 +663,23 @@ def main(argv=None):
                 "vs_baseline": head["vs_baseline"],
                 "device": head["device"],
                 "detail": [
-                    {k: r[k]
-                     for k in ("metric", "value", "unit", "vs_baseline")}
+                    dict(
+                        {k: r[k]
+                         for k in ("metric", "value", "unit", "vs_baseline")},
+                        # N³-scaled estimates must not read as measured
+                        **({"extrapolated": True}
+                           if r.get("extrapolated") else {}),
+                    )
                     for r in results
                 ],
             }
+            # headline consumers assume results[0] is the intended headline
+            # config; flag it when that config failed and a different
+            # metric/unit took its place
+            if names and results[0].get("config_name") != names[0]:
+                line["headline_fallback"] = True
+            if head.get("extrapolated"):
+                line["extrapolated"] = True
         if failed:
             line["configs_failed"] = failed
         if interrupted is not None:
@@ -713,6 +721,7 @@ def main(argv=None):
                 failed.append(name)
                 continue
             r["device"] = device.device_kind
+            r["config_name"] = name
             print(f"# {json.dumps(r)}", file=sys.stderr)
             results.append(r)
     except BaseException as exc:

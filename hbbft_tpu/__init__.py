@@ -1,4 +1,4 @@
-"""hbbft_tpu — a TPU-native (JAX/XLA/Pallas) HoneyBadgerBFT framework.
+"""hbbft_tpu — a TPU-native (JAX/XLA) HoneyBadgerBFT framework.
 
 A brand-new implementation of the capabilities of the Rust consensus library
 ``yangl1996/hbbft`` (fork of ``poanetwork/hbbft``): a sans-I/O, deterministic

@@ -30,6 +30,8 @@ class FaultKind(enum.Enum):
     MultipleValues = "broadcast: received multiple Values from the proposer"
     MultipleEchos = "broadcast: received multiple Echos from a node"
     MultipleReadys = "broadcast: received multiple Readys from a node"
+    MultipleEchoHashes = "broadcast: received multiple EchoHashes from a node"
+    MultipleCanDecodes = "broadcast: received multiple CanDecodes from a node"
     NotAProposer = "broadcast: Value message from a node that is not the proposer"
     UnknownSender = "message from a node that is not on the network"
     # binary agreement
@@ -60,6 +62,8 @@ class FaultKind(enum.Enum):
     InvalidPart = "sync_key_gen: invalid Part (bad commitment/row)"
     InvalidAck = "sync_key_gen: invalid Ack (bad value)"
     EchoHashConflict = "broadcast: EchoHash conflicts with a full Echo"
+    # (EchoHashConflict is raised by broadcast when a node's hash-only echo
+    # evidence names a different root than its full Echo)
 
 
 @dataclass(frozen=True)

@@ -1,4 +1,4 @@
-"""Batched TPU kernels (jnp/Pallas) for the protocol hot path, with host
+"""Batched TPU kernels (jnp/XLA) for the protocol hot path, with host
 (numpy) oracles.
 
 - ``gf256`` — GF(2^8) arithmetic (poly 0x11D, generator 2, matching the

@@ -47,7 +47,29 @@ class ReadyMsg:
     root: bytes
 
 
-BroadcastMessage = object  # ValueMsg | EchoMsg | ReadyMsg
+@dataclass(frozen=True)
+class EchoHashMsg:
+    """Echo *evidence* without the shard (reference:
+    ``src/broadcast/message.rs :: Message::EchoHash`` [LOW] — the upstream
+    message-reduction optimization).  Sent instead of a full ``Echo`` to
+    peers that already announced ``CanDecode``: they no longer need the
+    shard content, only proof that this sender echoed the root, which is
+    all the N−f Ready threshold requires."""
+
+    root: bytes
+
+
+@dataclass(frozen=True)
+class CanDecodeMsg:
+    """Announcement that this node holds ≥ N−2f shards for ``root`` and
+    needs no further shard payloads (reference: ``Message::CanDecode``
+    [LOW]).  Peers that have not echoed to us yet send ``EchoHash``
+    instead of the full shard+proof, saving O(N · shard) bytes per node."""
+
+    root: bytes
+
+
+BroadcastMessage = object  # ValueMsg | EchoMsg | ReadyMsg | EchoHash | CanDecode
 
 
 class Broadcast(ConsensusProtocol):
@@ -69,6 +91,9 @@ class Broadcast(ConsensusProtocol):
         self.value_received = False
         self.value_proof: Optional[Proof] = None
         self.echos: Dict[NodeId, Proof] = {}
+        self.echo_hashes: Dict[NodeId, bytes] = {}  # shard-less echo evidence
+        self.can_decodes: Dict[NodeId, bytes] = {}  # peers that need no shard
+        self.can_decode_sent = False
         self.readys: Dict[NodeId, bytes] = {}
         self.output: Optional[bytes] = None
         self.fault: bool = False  # proposer proven faulty (root mismatch)
@@ -98,6 +123,10 @@ class Broadcast(ConsensusProtocol):
             return self._handle_echo(sender_id, message.proof)
         if isinstance(message, ReadyMsg):
             return self._handle_ready(sender_id, message.root)
+        if isinstance(message, EchoHashMsg):
+            return self._handle_echo_hash(sender_id, message.root)
+        if isinstance(message, CanDecodeMsg):
+            return self._handle_can_decode(sender_id, message.root)
         raise TypeError(f"unknown broadcast message {message!r}")
 
     # -- internals ----------------------------------------------------------
@@ -154,7 +183,20 @@ class Broadcast(ConsensusProtocol):
         step = Step()
         if not self.echo_sent:
             self.echo_sent = True
-            step.send_all(EchoMsg(proof))
+            # full shard+proof to everyone still needing shards (Target::All
+            # so observers are reached too); hash-only evidence to peers
+            # that already announced CanDecode(root)
+            root = proof.root_hash
+            cd_peers = {
+                nid for nid, r in self.can_decodes.items()
+                if r == root and nid != self.our_id()
+            }
+            if cd_peers:
+                for nid in cd_peers:
+                    step.send_to(nid, EchoHashMsg(root))
+                step.send(Target.all_except(cd_peers), EchoMsg(proof))
+            else:
+                step.send_all(EchoMsg(proof))
             step.extend(self._handle_echo(self.our_id(), proof))
         return step
 
@@ -163,17 +205,59 @@ class Broadcast(ConsensusProtocol):
             if self.echos[sender_id] == proof:
                 return Step()
             return Step.from_fault(sender_id, FaultKind.MultipleEchos)
+        if self.echo_hashes.get(sender_id, proof.root_hash) != proof.root_hash:
+            return Step.from_fault(sender_id, FaultKind.EchoHashConflict)
         if not self._validate_proof(proof, sender_id):
             return Step.from_fault(sender_id, FaultKind.InvalidProof)
         self.echos[sender_id] = proof
         step = Step()
         root = proof.root_hash
+        step.extend(self._maybe_send_can_decode(root))
+        step.extend(self._maybe_send_ready(root))
+        step.extend(self._try_decode())
+        return step
+
+    def _handle_echo_hash(self, sender_id: NodeId, root: bytes) -> Step:
+        if sender_id in self.echo_hashes:
+            if self.echo_hashes[sender_id] == root:
+                return Step()
+            return Step.from_fault(sender_id, FaultKind.MultipleEchoHashes)
+        prev = self.echos.get(sender_id)
+        if prev is not None and prev.root_hash != root:
+            return Step.from_fault(sender_id, FaultKind.EchoHashConflict)
+        self.echo_hashes[sender_id] = root
+        step = self._maybe_send_ready(root)
+        step.extend(self._try_decode())
+        return step
+
+    def _handle_can_decode(self, sender_id: NodeId, root: bytes) -> Step:
+        if sender_id in self.can_decodes:
+            if self.can_decodes[sender_id] == root:
+                return Step()
+            return Step.from_fault(sender_id, FaultKind.MultipleCanDecodes)
+        self.can_decodes[sender_id] = root
+        return Step()
+
+    def _maybe_send_ready(self, root: bytes) -> Step:
+        """N−f echo *evidence* (full shards or hashes) → send Ready."""
+        step = Step()
         n, f = self.netinfo.num_nodes(), self.netinfo.num_faulty()
-        if self._count_echos(root) >= n - f and not self.ready_sent:
+        if self._count_echo_evidence(root) >= n - f and not self.ready_sent:
             self.ready_sent = True
             step.send_all(ReadyMsg(root))
             step.extend(self._handle_ready(self.our_id(), root))
-        step.extend(self._try_decode())
+        return step
+
+    def _maybe_send_can_decode(self, root: bytes) -> Step:
+        """≥ N−2f full shards in hand → tell peers to stop sending shards."""
+        step = Step()
+        if (
+            not self.can_decode_sent
+            and not self.decided
+            and self._count_echos(root) >= self.data_shard_num
+        ):
+            self.can_decode_sent = True
+            step.send_all(CanDecodeMsg(root))
         return step
 
     def _handle_ready(self, sender_id: NodeId, root: bytes) -> Step:
@@ -194,6 +278,18 @@ class Broadcast(ConsensusProtocol):
 
     def _count_echos(self, root: bytes) -> int:
         return sum(1 for p in self.echos.values() if p.root_hash == root)
+
+    def _count_echo_evidence(self, root: bytes) -> int:
+        """Distinct senders known to have echoed ``root`` — full shards plus
+        hash-only EchoHash evidence (enough for the Ready threshold; decode
+        still requires ``data_shard_num`` full shards)."""
+        senders = {
+            nid for nid, p in self.echos.items() if p.root_hash == root
+        }
+        senders |= {
+            nid for nid, r in self.echo_hashes.items() if r == root
+        }
+        return len(senders)
 
     def _count_readys(self, root: bytes) -> int:
         return sum(1 for r in self.readys.values() if r == root)

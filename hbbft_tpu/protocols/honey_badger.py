@@ -29,7 +29,7 @@ from hbbft_tpu.crypto import tc
 from hbbft_tpu.fault_log import FaultKind
 from hbbft_tpu.netinfo import NetworkInfo
 from hbbft_tpu.protocols import subset as subset_mod
-from hbbft_tpu.protocols.subset import Subset
+from hbbft_tpu.protocols.subset import Subset, SubsetHandlingStrategy
 from hbbft_tpu.protocols.threshold_decrypt import (
     DecryptionMessage,
     ThresholdDecrypt,
@@ -123,11 +123,15 @@ _ENCRYPTED = 0x01
 class _EpochState:
     """Reference: ``src/honey_badger/epoch_state.rs :: EpochState``."""
 
-    def __init__(self, netinfo: NetworkInfo, session_id: bytes, epoch: int):
+    def __init__(self, netinfo: NetworkInfo, session_id: bytes, epoch: int,
+                 subset_handling_strategy=None):
         self.netinfo = netinfo
         self.epoch = epoch
         self.subset = Subset(
-            netinfo, session_id + b"/hb-epoch/" + struct.pack(">Q", epoch)
+            netinfo, session_id + b"/hb-epoch/" + struct.pack(">Q", epoch),
+            handling_strategy=(
+                subset_handling_strategy or SubsetHandlingStrategy.Incremental
+            ),
         )
         self.decrypts: Dict[NodeId, ThresholdDecrypt] = {}
         self.plain: Dict[NodeId, bytes] = {}
@@ -157,6 +161,7 @@ class HoneyBadgerBuilder:
         self._session_id = b"hb"
         self._max_future_epochs = 3
         self._encryption_schedule = EncryptionSchedule.always()
+        self._subset_handling_strategy = None
         self._rng: Optional[random.Random] = None
 
     def session_id(self, sid: bytes) -> "HoneyBadgerBuilder":
@@ -175,6 +180,11 @@ class HoneyBadgerBuilder:
         self._rng = rng
         return self
 
+    def subset_handling_strategy(self, s) -> "HoneyBadgerBuilder":
+        """Reference: ``HoneyBadgerBuilder::subset_handling_strategy``."""
+        self._subset_handling_strategy = s
+        return self
+
     def build(self) -> "HoneyBadger":
         return HoneyBadger(
             self.netinfo,
@@ -182,6 +192,7 @@ class HoneyBadgerBuilder:
             max_future_epochs=self._max_future_epochs,
             encryption_schedule=self._encryption_schedule,
             rng=self._rng or random.Random(0),
+            subset_handling_strategy=self._subset_handling_strategy,
         )
 
 
@@ -195,6 +206,7 @@ class HoneyBadger(ConsensusProtocol):
         max_future_epochs: int = 3,
         encryption_schedule: Optional[EncryptionSchedule] = None,
         rng: Optional[random.Random] = None,
+        subset_handling_strategy=None,
     ):
         self.netinfo = netinfo
         self.session_id = bytes(session_id)
@@ -202,6 +214,7 @@ class HoneyBadger(ConsensusProtocol):
         self.max_future_epochs = max_future_epochs
         self.encryption_schedule = encryption_schedule or EncryptionSchedule.always()
         self.rng = rng or random.Random(0)
+        self.subset_handling_strategy = subset_handling_strategy
         self.epochs: Dict[int, _EpochState] = {}
         self.has_input: Dict[int, bool] = {}
         self.completed: Dict[int, Batch] = {}
@@ -275,7 +288,8 @@ class HoneyBadger(ConsensusProtocol):
     def _epoch_state(self, epoch: int) -> _EpochState:
         if epoch not in self.epochs:
             self.epochs[epoch] = _EpochState(
-                self.netinfo, self.session_id, epoch
+                self.netinfo, self.session_id, epoch,
+                subset_handling_strategy=self.subset_handling_strategy,
             )
         return self.epochs[epoch]
 

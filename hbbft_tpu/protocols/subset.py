@@ -13,6 +13,7 @@ All correct nodes output the same ≥ N−f proposal set.
 
 from __future__ import annotations
 
+import enum
 from dataclasses import dataclass
 from typing import Dict, Hashable, Optional
 
@@ -38,6 +39,22 @@ class BroadcastWrap:
 class AgreementWrap:
     proposer_id: NodeId
     msg: object
+
+
+class SubsetHandlingStrategy(enum.Enum):
+    """When accepted contributions are released to the caller.
+
+    Reference: ``src/subset/ :: SubsetHandlingStrategy`` (builder knob,
+    [MED]).  ``Incremental`` emits each ``Contribution`` as soon as its BA
+    decides true and the value is in hand (lower latency for callers that
+    can start work per-contribution, e.g. spawning threshold-decrypts);
+    ``AllAtEnd`` withholds them and emits the entire accepted set
+    immediately before ``Done`` (single completion event).
+    The decided *set* is identical either way.
+    """
+
+    Incremental = "incremental"
+    AllAtEnd = "all_at_end"
 
 
 # -- outputs (reference: SubsetOutput) ---------------------------------------
@@ -68,9 +85,17 @@ class _ProposalState:
 class Subset(ConsensusProtocol):
     """Reference: ``src/subset/subset.rs :: Subset<N, S>``."""
 
-    def __init__(self, netinfo: NetworkInfo, session_id: bytes):
+    def __init__(
+        self,
+        netinfo: NetworkInfo,
+        session_id: bytes,
+        handling_strategy: SubsetHandlingStrategy = (
+            SubsetHandlingStrategy.Incremental
+        ),
+    ):
         self.netinfo = netinfo
         self.session_id = bytes(session_id)
+        self.handling_strategy = handling_strategy
         self.proposals: Dict[NodeId, _ProposalState] = {}
         for pid in netinfo.all_ids():
             ba_session = self.session_id + b"/ba/" + repr(pid).encode()
@@ -148,12 +173,18 @@ class Subset(ConsensusProtocol):
             return Step()
         step = Step()
         n, f = self.netinfo.num_nodes(), self.netinfo.num_faulty()
-        # emit newly-available accepted contributions
-        for pid in self.netinfo.all_ids():
-            prop = self.proposals[pid]
-            if prop.decision is True and prop.value is not None and not prop.emitted:
-                prop.emitted = True
-                step.output.append(Contribution(pid, prop.value))
+        # emit newly-available accepted contributions (AllAtEnd withholds
+        # them until the Done edge below)
+        if self.handling_strategy is SubsetHandlingStrategy.Incremental:
+            for pid in self.netinfo.all_ids():
+                prop = self.proposals[pid]
+                if (
+                    prop.decision is True
+                    and prop.value is not None
+                    and not prop.emitted
+                ):
+                    prop.emitted = True
+                    step.output.append(Contribution(pid, prop.value))
         # N−f accepted → vote false on the rest
         if self._count_true() >= n - f and not self.false_inputs_sent:
             self.false_inputs_sent = True
@@ -164,14 +195,29 @@ class Subset(ConsensusProtocol):
                     step.extend(
                         self._process_agreement_step(pid, ba_step)
                     )
-        # all decided and all accepted values delivered → Done
+        # all decided and all accepted values in hand → Done
         # (re-check self.done: a nested _try_progress via the false-input
         # loop may already have emitted it)
-        if not self.done and all(
+        all_decided = all(
             p.decision is not None for p in self.proposals.values()
-        ) and all(
-            p.emitted or p.decision is False for p in self.proposals.values()
-        ):
+        )
+        if self.handling_strategy is SubsetHandlingStrategy.Incremental:
+            complete = all(
+                p.emitted or p.decision is False
+                for p in self.proposals.values()
+            )
+        else:  # AllAtEnd: accepted values present, none emitted yet
+            complete = all(
+                p.decision is False or p.value is not None
+                for p in self.proposals.values()
+            )
+        if not self.done and all_decided and complete:
             self.done = True
+            if self.handling_strategy is SubsetHandlingStrategy.AllAtEnd:
+                for pid in self.netinfo.all_ids():
+                    prop = self.proposals[pid]
+                    if prop.decision is True and not prop.emitted:
+                        prop.emitted = True
+                        step.output.append(Contribution(pid, prop.value))
             step.output.append(Done())
         return step

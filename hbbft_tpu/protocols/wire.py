@@ -186,7 +186,9 @@ def _lazy_register():
     from hbbft_tpu.protocols.binary_agreement import (
         AuxMsg, BValMsg, ConfMsg, CoinMsg, TermMsg,
     )
-    from hbbft_tpu.protocols.broadcast import EchoMsg, ReadyMsg, ValueMsg
+    from hbbft_tpu.protocols.broadcast import (
+        CanDecodeMsg, EchoHashMsg, EchoMsg, ReadyMsg, ValueMsg,
+    )
     from hbbft_tpu.protocols.dynamic_honey_badger import (
         HbWrap, KeyGenWrap, SignedKeyGenMsg,
     )
@@ -247,6 +249,12 @@ def _lazy_register():
     _register(0x12, ReadyMsg,
               lambda m: m.root,
               lambda r: ReadyMsg(r.take(32)))
+    _register(0x13, EchoHashMsg,
+              lambda m: m.root,
+              lambda r: EchoHashMsg(r.take(32)))
+    _register(0x14, CanDecodeMsg,
+              lambda m: m.root,
+              lambda r: CanDecodeMsg(r.take(32)))
     # ABA ------------------------------------------------------------------
     _register(0x20, BValMsg,
               lambda m: u64(m.epoch) + boolb(m.value),

@@ -94,8 +94,11 @@ class CostModel:
         - Value: N shards+proofs (one per instance addressed to us);
         - Echo: N instances × N sources, shard+proof each;
         - Ready: N × N digests;
-        - per ABA epoch: N instances × N sources × (BVal+Aux+Conf ≈ 3
-          one-byte votes) and, on coin epochs, N×N 96-byte G2 shares.
+        - per ABA epoch: N instances × N sources × 3 votes (BVal+Aux+Conf),
+          charged at 8 framed bytes per vote (1 payload byte + wire/header
+          overhead), and on coin epochs N×N 96-byte G2 shares — the coin
+          term charges at least one coin epoch even when aba_epochs < 3,
+          covering the schedule's mandatory first threshold-coin flip.
         """
         k = max(n - 2 * f, 1)
         shard = max(2, -(-(4 + payload_bytes) // k))

@@ -148,3 +148,105 @@ def test_random_adversary_with_duplication_many_seeds():
         net = run_broadcast(7, RandomAdversary(seed=seed, dup_prob=0.2))
         for nid in net.node_ids():
             assert net.nodes[nid].outputs == [b"the proposed value"]
+
+
+# -- EchoHash / CanDecode message-reduction optimization ---------------------
+# (reference: src/broadcast/message.rs :: Message::{EchoHash, CanDecode})
+
+
+def test_can_decode_switches_echo_to_hash():
+    """A node that received CanDecode(root) from a peer before its own Value
+    sends that peer hash-only EchoHash instead of the full shard."""
+    from hbbft_tpu.protocols.broadcast import CanDecodeMsg, EchoHashMsg, EchoMsg
+
+    n = 4
+    infos = make_netinfos(n)
+    proposer = Broadcast(infos[0], 0)
+    step = proposer.handle_input(b"shard me" * 5)
+    values = {
+        next(iter(m.target.ids)): m.message
+        for m in step.messages if isinstance(m.message, ValueMsg)
+    }
+
+    node1 = Broadcast(infos[1], 0)
+    root = values[1].proof.root_hash
+    # peer 2 says it can decode; peer 3 says nothing
+    s = node1.handle_message(2, CanDecodeMsg(root))
+    assert not len(s.fault_log)
+    s = node1.handle_message(0, values[1])
+    hash_targets = set()
+    echo_excepts = None
+    for m in s.messages:
+        if isinstance(m.message, EchoHashMsg):
+            assert m.message.root == root
+            hash_targets |= set(m.target.ids)
+        elif isinstance(m.message, EchoMsg):
+            echo_excepts = set(m.target.ids)  # ALL_EXCEPT the hash peers
+    assert hash_targets == {2}
+    assert echo_excepts == {2}  # full shards go to everyone else incl. observers
+
+
+def test_echo_hash_counts_toward_ready_threshold():
+    """EchoHash evidence (no shard) still drives the N−f Ready rule."""
+    from hbbft_tpu.protocols.broadcast import EchoHashMsg, EchoMsg
+
+    n = 4
+    infos = make_netinfos(n)
+    proposer = Broadcast(infos[0], 0)
+    step = proposer.handle_input(b"payload!" * 3)
+    values = {
+        next(iter(m.target.ids)): m.message
+        for m in step.messages if isinstance(m.message, ValueMsg)
+    }
+
+    node1 = Broadcast(infos[1], 0)
+    root = values[1].proof.root_hash
+    node1.handle_message(0, values[1])          # own echo (1 evidence)
+    assert not node1.ready_sent
+    node1.handle_message(0, EchoHashMsg(root))  # proposer's hash evidence
+    assert not node1.ready_sent
+    s = node1.handle_message(2, EchoHashMsg(root))  # third → N−f = 3
+    assert node1.ready_sent
+    assert any(isinstance(m.message, ReadyMsg) for m in s.messages)
+
+
+def test_echo_hash_conflict_fault():
+    from hbbft_tpu.fault_log import FaultKind
+    from hbbft_tpu.protocols.broadcast import EchoHashMsg
+
+    n = 4
+    infos = make_netinfos(n)
+    proposer = Broadcast(infos[0], 0)
+    step = proposer.handle_input(b"conflicted")
+    values = {
+        next(iter(m.target.ids)): m.message
+        for m in step.messages if isinstance(m.message, ValueMsg)
+    }
+    echo_from_2 = None
+    node1 = Broadcast(infos[1], 0)
+    node1.handle_message(0, values[1])
+    # node 2's full echo would carry its own proof; simulate with the real
+    # one by building node 2 and capturing its echo to node 1
+    from hbbft_tpu.protocols.broadcast import EchoMsg
+
+    node2 = Broadcast(infos[2], 0)
+    s2 = node2.handle_message(0, values[2])
+    for m in s2.messages:
+        if isinstance(m.message, EchoMsg):
+            echo_from_2 = m.message
+            break
+    assert echo_from_2 is not None
+    node1.handle_message(2, echo_from_2)
+    # now node 2 "sends" an EchoHash naming a different root → fault
+    s = node1.handle_message(2, EchoHashMsg(b"\x99" * 32))
+    kinds = [f.kind for f in s.fault_log.faults]
+    assert FaultKind.EchoHashConflict in kinds
+
+
+def test_full_broadcast_still_delivers_with_new_messages():
+    """e2e sanity: the optimization messages flow through VirtualNet and the
+    value still delivers everywhere (CanDecode fires in the happy path)."""
+    n = 7
+    net = run_broadcast(n, NullAdversary(), value=b"x" * 300)
+    for nid in net.node_ids():
+        assert net.nodes[nid].algorithm.output == b"x" * 300

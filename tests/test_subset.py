@@ -92,3 +92,59 @@ def test_subset_outputs_identical_across_seeds():
         assert len(vals) == 1
         if reference is None:
             reference = vals
+
+
+def test_all_at_end_strategy_single_completion_event():
+    """AllAtEnd (reference builder knob ``SubsetHandlingStrategy``) releases
+    every accepted contribution in the same step as Done — and the decided
+    set matches the Incremental run exactly."""
+    from hbbft_tpu.protocols.subset import SubsetHandlingStrategy
+
+    n = 4
+    infos = infos_for(n)
+    inputs = {i: f"proposal-{i}".encode() for i in range(n)}
+
+    def run(strategy):
+        net = NetBuilder(list(range(n))).adversary(NullAdversary()).using_step(
+            lambda nid: Subset(
+                infos[nid], b"subset-strategy", handling_strategy=strategy
+            )
+        )
+        for nid, v in inputs.items():
+            net.send_input(nid, v)
+        return net
+
+    def crank_watching(net):
+        """first-crank-with-a-Contribution and crank-of-Done per node."""
+        first_contrib = {}
+        done_at = {}
+        crank = 0
+        while net.queue:
+            net.crank()
+            crank += 1
+            for nid in net.node_ids():
+                outs = net.nodes[nid].outputs
+                if nid not in first_contrib and any(
+                    isinstance(o, Contribution) for o in outs
+                ):
+                    first_contrib[nid] = crank
+                if nid not in done_at and any(
+                    isinstance(o, Done) for o in outs
+                ):
+                    done_at[nid] = crank
+        return first_contrib, done_at
+
+    inc = run(SubsetHandlingStrategy.Incremental)
+    ate = run(SubsetHandlingStrategy.AllAtEnd)
+    fc_a, done_a = crank_watching(ate)
+    fc_i, done_i = crank_watching(inc)
+    for nid in ate.node_ids():
+        node = ate.nodes[nid]
+        assert node.algorithm.terminated()
+        assert isinstance(node.outputs[-1], Done)
+        # AllAtEnd: contributions appear in the same crank as Done
+        assert fc_a[nid] == done_a[nid], (nid, fc_a[nid], done_a[nid])
+        assert contributions(node) == contributions(inc.nodes[nid])
+    # Incremental actually streams: at least one node saw a contribution
+    # strictly before its Done
+    assert any(fc_i[nid] < done_i[nid] for nid in inc.node_ids())

@@ -187,3 +187,11 @@ def test_live_dhb_traffic_roundtrips():
         for _ in range(len(net.queue)):
             net.crank()
     assert "HbWrap" in kinds
+
+
+def test_echo_hash_can_decode_roundtrip():
+    from hbbft_tpu.protocols.broadcast import CanDecodeMsg, EchoHashMsg
+
+    tree = MerkleTree([b"shard-%d" % i for i in range(4)])
+    rt(EchoHashMsg(tree.root_hash()))
+    rt(CanDecodeMsg(tree.root_hash()))
