@@ -32,6 +32,24 @@ from hbbft_tpu.crypto import bls12_381 as c
 from hbbft_tpu.ops import gcurve as G
 
 _RAND_BITS = 128
+# ladder width for the windowed device ladders: the 129 bits of an odd
+# 128-bit randomizer (or GLV half-scalar), rounded up to the 4-bit window
+_WINDOW_BITS = 132
+
+
+def _field_rep():
+    """Device field backend for the MSM ladders: the MXU 8-bit-digit field
+    (default — matmul limb products, smaller graphs) or the 13-bit VPU lazy
+    field (``HBBFT_FIELD_BACKEND=lazy``).  Both are exact; speed choice."""
+    import os
+
+    if os.environ.get("HBBFT_FIELD_BACKEND", "mxu") == "lazy":
+        from hbbft_tpu.ops import fp381 as rep
+
+        return rep, G.LAZY_FP_OPS, G.LAZY_FP2_OPS
+    from hbbft_tpu.ops import fp381_mxu as rep
+
+    return rep, G.MXU_FP_OPS, G.MXU_FP2_OPS
 
 
 class _MsmCache:
@@ -44,17 +62,51 @@ class _MsmCache:
         # one jitted LADDER per (group, padded size); the final fold over
         # the ≤size ladder outputs happens on the host — a handful of bigint
         # adds, versus log2(size) more big point_add graphs to compile.
-        # The ladder runs the LAZY (non-canonical) field: randomizers are
+        # The ladder runs a LAZY (non-canonical) field: randomizers are
         # 128-bit, which is exactly the regime where its digit-based zero
         # checks are sound (see ops/fp381.py); host fold canonicalizes.
+        # I/O is ONE stacked array each way: per-coordinate transfers cost a
+        # full tunnel round-trip each (~100 ms) on the remote-chip setup.
         key = (group, size)
         if key not in self._fns:
             import jax
+            import jax.numpy as jnp
 
-            ops = G.LAZY_FP_OPS if group == "g1" else G.LAZY_FP2_OPS
-            self._fns[key] = jax.jit(
-                lambda p, b, inf: G.scalar_mul_lazy(ops, p, b, inf)
-            )
+            rep, fp_ops, fp2_ops = _field_rep()
+
+            def pack(flat, oinf):
+                # the inf flags ride as one extra int32 row so the result
+                # is ONE device→host transfer (each transfer is a full
+                # tunnel round-trip on the remote-chip setup)
+                nl = flat.shape[-1]
+                inf_row = jnp.pad(
+                    oinf.astype(flat.dtype)[:, None], ((0, 0), (0, nl - 1))
+                )
+                return jnp.concatenate([flat, inf_row[None]], 0)
+
+            if group == "g1":
+
+                def ladder(stacked, b, inf):
+                    pt = (stacked[0], stacked[1], stacked[2])
+                    out, oinf = G.scalar_mul_lazy_window(fp_ops, pt, b, inf)
+                    return pack(jnp.stack(out), oinf)
+
+            else:
+
+                def ladder(stacked, b, inf):
+                    pt = (
+                        (stacked[0], stacked[1]),
+                        (stacked[2], stacked[3]),
+                        (stacked[4], stacked[5]),
+                    )
+                    out, oinf = G.scalar_mul_lazy_window(fp2_ops, pt, b, inf)
+                    flat = jnp.stack(
+                        [out[0][0], out[0][1], out[1][0], out[1][1],
+                         out[2][0], out[2][1]]
+                    )
+                    return pack(flat, oinf)
+
+            self._fns[key] = (jax.jit(ladder), rep)
         return self._fns[key]
 
     @staticmethod
@@ -64,37 +116,54 @@ class _MsmCache:
             size *= 2
         return size
 
-    def _msm(self, group: str, points, scalars):
+    def _msm_dispatch(self, group: str, points, scalars):
+        """Enqueue a ladder on the device, returning a handle for
+        :meth:`_msm_collect`.  Dispatch/collect split so independent MSMs
+        (e.g. the G1+G2 pair of a signature batch-verify) overlap on the
+        device instead of serializing on the result transfer."""
         import jax.numpy as jnp
 
         size = self._pad(len(points))
+        fn, rep = self._get(group, size)
         pts = list(points) + [None] * (size - len(points))
         sc = list(scalars) + [0] * (size - len(scalars))
         if group == "g1":
-            dev = tuple(jnp.asarray(x) for x in G.g1_to_device(pts))
-            # bulk device→host + one vectorized limb decode per coordinate —
-            # per-row np.asarray(x[i]) costs a full device round-trip each
-            # (≈160 s for 256 G2 points through the tunneled chip vs <1 s)
-            from_batch = G.g1_from_device_batch
+            stacked = np.stack(G.g1_to_device(pts, rep=rep))  # (3, B, NL)
+        else:
+            stacked = np.stack([
+                x for coord in G.g2_to_device(pts, rep=rep) for x in coord
+            ])  # (6, B, NL)
+        bits = jnp.asarray(G.scalars_to_bits(sc, nbits=_WINDOW_BITS))
+        base_inf = jnp.asarray(np.array([p is None for p in pts]))
+        packed = fn(jnp.asarray(stacked), bits, base_inf)
+        return (group, rep, len(points), packed)
+
+    def _msm_collect(self, handle):
+        group, rep, n_pts, packed = handle
+        # ONE bulk device→host transfer for all coordinates + the inf flags
+        packed = np.asarray(packed)
+        out = packed[:-1]
+        inf = packed[-1, :, 0].astype(bool)
+        if group == "g1":
+            host_pts = G.g1_from_device_batch(
+                (out[0], out[1], out[2]), rep=rep
+            )
             host_add = c.g1_add
         else:
-            dev = tuple(
-                tuple(jnp.asarray(x) for x in coord)
-                for coord in G.g2_to_device(pts)
+            host_pts = G.g2_from_device_batch(
+                ((out[0], out[1]), (out[2], out[3]), (out[4], out[5])),
+                rep=rep,
             )
-            from_batch = G.g2_from_device_batch
             host_add = c.g2_add
-        bits = jnp.asarray(G.scalars_to_bits(sc, nbits=_RAND_BITS + 1))
-        base_inf = jnp.asarray(np.array([p is None for p in pts]))
-        out, inf = self._get(group, size)(dev, bits, base_inf)
-        inf = np.asarray(inf)
-        host_pts = from_batch(out)  # lazy coords of ∞ entries are garbage —
-        acc = None                  # the inf flag, not Z, is authoritative
-        for i in range(len(points)):
+        acc = None  # lazy coords of ∞ entries are garbage —
+        for i in range(n_pts):  # the inf flag, not Z, is authoritative
             if inf[i]:
                 continue
             acc = host_add(acc, host_pts[i])
         return acc
+
+    def _msm(self, group: str, points, scalars):
+        return self._msm_collect(self._msm_dispatch(group, points, scalars))
 
     def msm_g1(self, points, scalars):
         """points: host Jacobian G1 points; scalars: ints. → host point."""
@@ -120,19 +189,23 @@ class _MsmCache:
 
         B = len(points)
         size = self._pad(B)
+        fn, rep = self._get("g1", 2 * size)
         pts = list(points) + [None] * (size - B)
         sc = [s % c.R for s in scalars] + [0] * (size - B)
         a = [s % c.LAMBDA_G1 for s in sc]
         b = [s // c.LAMBDA_G1 for s in sc]
         phi = [c.g1_endo(p) for p in pts]
 
-        dev = tuple(jnp.asarray(x) for x in G.g1_to_device(pts + phi))
-        bits = jnp.asarray(G.scalars_to_bits(a + b, nbits=_RAND_BITS))
+        stacked = np.stack(G.g1_to_device(pts + phi, rep=rep))
+        bits = jnp.asarray(G.scalars_to_bits(a + b, nbits=_WINDOW_BITS))
         base_inf = jnp.asarray(np.array([p is None for p in pts] * 2))
-        out, inf = self._get("g1", 2 * size)(dev, bits, base_inf)
+        packed = np.asarray(fn(jnp.asarray(stacked), bits, base_inf))
 
-        host_pts = G.g1_from_device_batch(out)  # a·P rows, then b·φ(P)
-        inf_h = np.asarray(inf)
+        out = packed[:-1]  # one bulk transfer, inf flags in the last row
+        host_pts = G.g1_from_device_batch(
+            (out[0], out[1], out[2]), rep=rep
+        )  # a·P rows, then b·φ(P)
+        inf_h = packed[-1, :, 0].astype(bool)
         res = []
         for i in range(B):
             lo = None if inf_h[i] else host_pts[i]
@@ -287,8 +360,12 @@ def batch_verify_sig_shares(
     if not pairs:
         return True
     rs = [rng.getrandbits(_RAND_BITS) | 1 for _ in pairs]
-    sig_comb = _CACHE.msm_g2([s.point for _, s in pairs], rs)
-    pk_comb = _CACHE.msm_g1([p.point for p, _ in pairs], rs)
+    # dispatch both ladders before collecting either — they overlap on
+    # the device
+    h_sig = _CACHE._msm_dispatch("g2", [s.point for _, s in pairs], rs)
+    h_pk = _CACHE._msm_dispatch("g1", [p.point for p, _ in pairs], rs)
+    sig_comb = _CACHE._msm_collect(h_sig)
+    pk_comb = _CACHE._msm_collect(h_pk)
     h = c.hash_g2(msg)
     if sig_comb is None or pk_comb is None:
         # Σ rᵢσᵢ = ∞ happens only if shares are invalid (or all inputs ∞)
@@ -310,8 +387,10 @@ def batch_verify_dec_shares(
     from hbbft_tpu.crypto.tc import _hash_ciphertext_point
 
     rs = [rng.getrandbits(_RAND_BITS) | 1 for _ in pairs]
-    d_comb = _CACHE.msm_g1([d.point for _, d in pairs], rs)
-    pk_comb = _CACHE.msm_g1([p.point for p, _ in pairs], rs)
+    h_d = _CACHE._msm_dispatch("g1", [d.point for _, d in pairs], rs)
+    h_pk = _CACHE._msm_dispatch("g1", [p.point for p, _ in pairs], rs)
+    d_comb = _CACHE._msm_collect(h_d)
+    pk_comb = _CACHE._msm_collect(h_pk)
     h = _hash_ciphertext_point(ct.u, ct.v)
     if d_comb is None or pk_comb is None:
         return d_comb is None and pk_comb is None

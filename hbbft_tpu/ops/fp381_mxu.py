@@ -1,0 +1,264 @@
+"""MXU-formulated BLS12-381 base-field arithmetic: 8-bit digits, matmul
+limb products.
+
+The VPU lowering in :mod:`hbbft_tpu.ops.fp381` computes the 30×30 limb
+convolution as 30 sequential shifted FMAs — measured at the int32 VPU
+throughput floor (STATUS.md round-3 investigation).  This module reformulates
+the product so the arithmetic-heavy part runs on the MXU (the systolic
+array), which is the order-of-magnitude lever that investigation named:
+
+- **Representation**: 49 digits × 8 bits (radix 2⁸, little-endian) in int32
+  lanes; *lazy* invariant only — digits in [0, 256], value an arbitrary
+  residue (mod p).  8-bit digits are chosen so every matmul below is EXACT
+  in f32: digit products ≤ 2¹⁶ and row sums ≤ 97·2¹⁶ < 2²³ < 2²⁴ (the f32
+  integer-exactness bound).  49 digits (392 ≥ 381 bits) leave the same
+  ~11-bit fold headroom per squeeze round as the 13-bit field's 390-bit
+  layout — 48 would leave only 3 bits and the top-digit fold would not
+  converge.
+- **Convolution as matmul**: t_k = Σ_{i+j=k} a_i·b_j is the batched outer
+  product a⊗b (B, 48, 48) contracted against a constant one-hot tensor
+  S[(i,j), k] = [i+j = k] — i.e. ONE (B, 2304) @ (2304, 95) matmul that the
+  MXU executes at matrix throughput, replacing 48 sequential VPU FMAs.
+  ``jax.lax.Precision.HIGHEST`` keeps f32 multiplies exact on TPU (the
+  default TPU matmul truncates inputs to bf16).
+- **Modular fold as matmul**: digit positions ≥ 49 (values ≥ 2³⁹²) fold
+  against precomputed residue rows 2^(8m) mod p — a second constant-matrix
+  (B, hi) @ (hi, 48) matmul.
+- Carries stay rough (3 int32 VPU passes), exactly like the 13-bit lazy
+  field; zero/equality tests are digit-based with the same soundness
+  conditions (see fp381's lazy section: ladder scalars < 2¹²⁸, infinity as
+  an explicit flag).
+
+Reference: ``threshold_crypto``'s 64-bit limb field (``pairing``/``ff``) is
+the functional spec; the formulation here is TPU-native.  Host ground truth:
+:mod:`hbbft_tpu.crypto.bls12_381`; tests assert exact equality.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from hbbft_tpu.crypto.bls12_381 import P
+
+DIGIT_BITS = 8
+NL = 49  # 49 × 8 = 392 ≥ 381 (11 bits of fold headroom)
+MASK = (1 << DIGIT_BITS) - 1  # 255
+_CONV_OUT = 2 * NL - 1  # 95 positions before carrying
+_CARRY_PAD = 3  # carry room past the conv output
+
+
+def int_to_limbs(x: int, n: int = NL) -> np.ndarray:
+    """Host: python int → little-endian 8-bit digits (int32)."""
+    out = np.frombuffer(
+        int(x).to_bytes(n, "little"), dtype=np.uint8
+    ).astype(np.int32)
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    """Host: digit array (little-endian, any magnitudes) → python int."""
+    x = 0
+    for i, v in enumerate(np.asarray(limbs).tolist()):
+        x += int(v) << (DIGIT_BITS * i)
+    return x
+
+
+def ints_to_limbs_batch(xs, n: int = NL) -> np.ndarray:
+    """Host: ints in [0, 2^(8n)) → (B, n) int32 digits (LE bytes)."""
+    buf = b"".join(int(x).to_bytes(n, "little") for x in xs)
+    return (
+        np.frombuffer(buf, dtype=np.uint8)
+        .reshape(len(xs), n)
+        .astype(np.int32)
+    )
+
+
+_DIGIT_WEIGHTS = np.array(
+    [1 << (DIGIT_BITS * i) for i in range(NL + _CARRY_PAD)], dtype=object
+)
+
+
+def limbs_to_ints_batch(limbs) -> list:
+    """Host: (B, NL) digits (lazy magnitudes allowed) → python ints."""
+    arr = np.asarray(limbs)
+    return list(arr.astype(object) @ _DIGIT_WEIGHTS[: arr.shape[-1]])
+
+
+P_LIMBS = int_to_limbs(P)
+
+# one-hot convolution tensor: S[(i*NL + j), k] = 1 iff i + j == k
+_S_CONV = np.zeros((NL * NL, _CONV_OUT), dtype=np.float32)
+for _i in range(NL):
+    for _j in range(NL):
+        _S_CONV[_i * NL + _j, _i + _j] = 1.0
+
+# fold rows: 2^(8m) mod p for digit positions m ≥ NL (conv output + carry
+# room), as 8-bit digit rows — the constant matrix of the fold matmul
+_N_HI = _CONV_OUT + _CARRY_PAD - NL  # hi positions after carrying
+_FOLD_ROWS = np.stack(
+    [int_to_limbs((1 << (DIGIT_BITS * (NL + m))) % P) for m in range(_N_HI)]
+).astype(np.float32)  # (_N_HI, NL)
+
+# squeeze fold row: 2^392 mod p
+_ROW392 = int_to_limbs((1 << (DIGIT_BITS * NL)) % P)
+
+# ≡ −2·(2^392 − 1) (mod p), canonical — completes the digitwise complement
+# in fp_sub (same construction as fp381._SUBC_LIMBS in the 13-bit field)
+_SUBC_LIMBS = int_to_limbs((-2 * ((1 << (DIGIT_BITS * NL)) - 1)) % P)
+
+
+def _shift1(c):
+    """Shift digits up one position (pad/slice, not dynamic-update-slice —
+    DUS breaks XLA elementwise fusion and each unfused op is a separate
+    kernel launch, which is what the launch-bound ladders pay for)."""
+    import jax.numpy as jnp
+
+    pad = [(0, 0)] * (c.ndim - 1) + [(1, 0)]
+    return jnp.pad(c[..., :-1], pad)
+
+
+def _carry_rough(t):
+    """3 rough passes: limbs < 2^31 → digits ≤ 256 (lazy invariant)."""
+    for _ in range(3):
+        t = (t & MASK) + _shift1(t >> DIGIT_BITS)
+    return t
+
+
+def _squeeze(acc):
+    """(…, NL) int32 limbs with values < 2^31 → lazy-invariant digits.
+
+    Appends carry room, rough-carries, then folds the top digit back
+    through 2^392 mod p; each fold with a nonzero top digit shrinks the
+    overhang by ≥ 2^11 (2^392 vs p < 2^381), so 3 rounds reach top 0 from
+    any value < 2^410 (mirrors fp381._squeeze_lazy)."""
+    import jax.numpy as jnp
+
+    row = jnp.asarray(_ROW392)
+    zero1 = jnp.zeros((*acc.shape[:-1], 1), acc.dtype)
+    acc = jnp.concatenate([acc, zero1], -1)
+    acc = _carry_rough(acc)
+    for _ in range(3):
+        top = acc[..., NL : NL + 1]
+        acc = jnp.concatenate([acc[..., :NL] + top * row, zero1], -1)
+        acc = _carry_rough(acc)
+    return acc[..., :NL]
+
+
+def _conv_mxu(a, b):
+    """Digit convolution on the MXU: outer product + one-hot matmul.
+
+    a, b: int32 (..., NL), digits ≤ 256.  Returns int32 (..., _CONV_OUT)
+    with values ≤ 49·(256·256) < 2²³ — exact through f32."""
+    import jax
+    import jax.numpy as jnp
+
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    outer = af[..., :, None] * bf[..., None, :]  # (..., NL, NL) ≤ 2^16
+    flat = outer.reshape(*outer.shape[:-2], NL * NL)
+    conv = jnp.matmul(
+        flat, jnp.asarray(_S_CONV),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    return conv.astype(jnp.int32)
+
+
+def fp_mul(a, b):
+    """Lazy modular product, MXU path: conv matmul → carry → fold matmul
+    → squeeze.  Inputs/outputs int32 (..., NL) with digits ≤ 256."""
+    import jax
+    import jax.numpy as jnp
+
+    t = _conv_mxu(a, b)
+    t = jnp.concatenate(
+        [t, jnp.zeros((*t.shape[:-1], _CARRY_PAD), t.dtype)], -1
+    )
+    t = _carry_rough(t)  # digits ≤ 256 over NL + _N_HI positions
+    lo = t[..., :NL]
+    hi = t[..., NL:].astype(jnp.float32)  # (..., _N_HI) ≤ 256
+    fold = jnp.matmul(
+        hi, jnp.asarray(_FOLD_ROWS),
+        precision=jax.lax.Precision.HIGHEST,
+    )  # ≤ _N_HI·256·255 < 2^22 — exact
+    return _squeeze(lo + fold.astype(jnp.int32))
+
+
+def fp_sqr(a):
+    return fp_mul(a, a)
+
+
+def fp_add(a, b):
+    return _squeeze(a + b)
+
+
+def fp_sub(a, b):
+    """a − b (mod p), lazy: a + (2·MASK − b_digits) + const (the digitwise
+    complement represents 2·(2^392−1) − b; the constant is ≡ −2·(2^392−1))."""
+    import jax.numpy as jnp
+
+    t = a + (2 * MASK - b) + jnp.asarray(_SUBC_LIMBS)
+    return _squeeze(t)
+
+
+def fp_neg(a):
+    import jax.numpy as jnp
+
+    return fp_sub(jnp.zeros_like(a), a)
+
+
+def fp_is_zero_digits(a):
+    import jax.numpy as jnp
+
+    return jnp.all(a == 0, axis=-1)
+
+
+def fp_select(mask, a, b):
+    import jax.numpy as jnp
+
+    return jnp.where(mask[..., None], a, b)
+
+
+# -- Fp2 (Karatsuba, mirrors the 13-bit lazy field) --------------------------
+
+
+def fp2_add(a, b):
+    return (fp_add(a[0], b[0]), fp_add(a[1], b[1]))
+
+
+def fp2_sub(a, b):
+    return (fp_sub(a[0], b[0]), fp_sub(a[1], b[1]))
+
+
+def fp2_neg(a):
+    return (fp_neg(a[0]), fp_neg(a[1]))
+
+
+def fp2_mul(a, b):
+    """Karatsuba with the three independent products STACKED into one
+    fp_mul launch — one conv matmul of 3× the rows instead of three small
+    dispatches (the ladder's cost is op-launch-bound, not flop-bound)."""
+    import jax.numpy as jnp
+
+    lhs = jnp.stack([a[0], a[1], fp_add(a[0], a[1])])
+    rhs = jnp.stack([b[0], b[1], fp_add(b[0], b[1])])
+    t = fp_mul(lhs, rhs)
+    t0, t1, t2 = t[0], t[1], t[2]
+    return (fp_sub(t0, t1), fp_sub(t2, fp_add(t0, t1)))
+
+
+def fp2_sqr(a):
+    import jax.numpy as jnp
+
+    lhs = jnp.stack([fp_add(a[0], a[1]), a[0]])
+    rhs = jnp.stack([fp_sub(a[0], a[1]), a[1]])
+    t = fp_mul(lhs, rhs)
+    t0, t1 = t[0], t[1]
+    return (t0, fp_add(t1, t1))
+
+
+def fp2_is_zero_digits(a):
+    return fp_is_zero_digits(a[0]) & fp_is_zero_digits(a[1])
+
+
+def fp2_select(mask, a, b):
+    return (fp_select(mask, a[0], b[0]), fp_select(mask, a[1], b[1]))

@@ -77,6 +77,40 @@ class _LazyFp2Ops:
     select = staticmethod(F.fp2_select)
 
 
+def _mxu():
+    from hbbft_tpu.ops import fp381_mxu as M
+
+    return M
+
+
+class _MxuFpOps:
+    """8-bit-digit MXU field (see ops/fp381_mxu.py) — lazy semantics, same
+    soundness conditions as the 13-bit lazy ops; pair with ``rep=fp381_mxu``
+    in the host converters."""
+
+    def __init__(self):
+        M = _mxu()
+        self.add = M.fp_add
+        self.sub = M.fp_sub
+        self.mul = M.fp_mul
+        self.sqr = M.fp_sqr
+        self.neg = M.fp_neg
+        self.is_zero = M.fp_is_zero_digits
+        self.select = M.fp_select
+
+
+class _MxuFp2Ops:
+    def __init__(self):
+        M = _mxu()
+        self.add = M.fp2_add
+        self.sub = M.fp2_sub
+        self.mul = M.fp2_mul
+        self.sqr = M.fp2_sqr
+        self.neg = M.fp2_neg
+        self.is_zero = M.fp2_is_zero_digits
+        self.select = M.fp2_select
+
+
 def _dbl_small(o, a, times: int):
     """a·2^times via repeated additions (host oracle's ``scal`` uses small
     integer factors 2 and 8 only)."""
@@ -251,6 +285,87 @@ def scalar_mul_lazy(o, pt, bits, base_inf):
     return jax.lax.fori_loop(0, nbits, body, (acc0, inf0))
 
 
+def scalar_mul_lazy_window(o, pt, bits, base_inf, w: int = 4):
+    """Windowed variant of :func:`scalar_mul_lazy`: same lazy-field and
+    scalar-regime soundness conditions, ~1.5× fewer point operations.
+
+    Precomputes the table [P, 2P, …, (2^w−1)P] (even entries by doubling,
+    odd by raw add — always distinct-x inside the scalar regime), then
+    processes ``w`` bits per iteration: w doubles + ONE table add selected
+    by a one-hot mask over the window value (gathers lower to slow loops on
+    TPU; 2^w−1 masked adds fuse into elementwise selects).
+
+    ``bits`` length must be a multiple of ``w`` (pad scalars_to_bits nbits
+    accordingly).  Returns ((X, Y, Z), inf_mask) like scalar_mul_lazy.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    nbits = bits.shape[-1]
+    assert nbits % w == 0, (nbits, w)
+    n_win = nbits // w
+
+    # table[k] = (k+1)·P for k in 0..2^w−2, built batched
+    table = [pt]
+    for k in range(2, 1 << w):
+        if k % 2 == 0:
+            table.append(point_double(o, table[k // 2 - 1]))
+        else:
+            table.append(point_add_raw(o, table[k - 2], pt))
+
+    def stack_coord(ci):
+        if isinstance(pt[ci], tuple):
+            return tuple(
+                jnp.stack([t[ci][j] for t in table])
+                for j in range(len(pt[ci]))
+            )
+        return jnp.stack([t[ci] for t in table])
+
+    tstack = tuple(stack_coord(ci) for ci in range(3))  # (2^w−1, B, NL)
+
+    def select_entry(idx):
+        """One-hot Σ_k [idx == k+1]·table[k] per coordinate — a single
+        k-contraction einsum per coordinate, not 2^w−1 masked adds."""
+        onehot = (
+            idx[None, :] == jnp.arange(1, 1 << w)[:, None]
+        ).astype(jnp.int32)  # (2^w−1, B)
+
+        def sel(c):
+            if isinstance(c, tuple):
+                return tuple(sel(x) for x in c)
+            return jnp.einsum("kb,kbd->bd", onehot, c)
+
+        return tuple(sel(c) for c in tstack)
+
+    def zeros_like_coord(c):
+        if isinstance(c, tuple):
+            return tuple(jnp.zeros_like(x) for x in c)
+        return jnp.zeros_like(c)
+
+    acc0 = tuple(zeros_like_coord(c) for c in pt)
+    inf0 = jnp.ones(base_inf.shape, dtype=bool)
+
+    def body(j, carry):
+        acc, inf = carry
+        for _ in range(w):
+            acc = point_double(o, acc)
+        # window value (MSB-first): bits are little-endian
+        start = nbits - (j + 1) * w
+        win = jax.lax.dynamic_slice_in_dim(bits, start, w, axis=-1)
+        weights = (1 << jnp.arange(w)).astype(win.dtype)
+        idx = jnp.sum(win * weights, axis=-1)  # (B,)
+        selT = select_entry(idx)
+        added = point_add_raw(o, acc, selT)
+        res = point_select(o, inf, selT, point_select(o, base_inf, acc, added))
+        res_inf = inf & base_inf
+        considered = idx != 0
+        acc = point_select(o, considered, res, acc)
+        inf = jnp.where(considered, res_inf, inf)
+        return acc, inf
+
+    return jax.lax.fori_loop(0, n_win, body, (acc0, inf0))
+
+
 def msm(o, pt, bits):
     """Σ_b bits[b]·pt[b] — batched ladders, then a tree of point_adds where
     each level HALVES the batch by adding the two halves.
@@ -303,13 +418,16 @@ def scalars_to_bits(scalars: Sequence[int], nbits: int = R_BITS) -> np.ndarray:
     return F.bits_batch(sc, nbits)
 
 
-def g1_to_device(points: Sequence[Optional[tuple]]) -> Tuple:
-    """Host Jacobian G1 points (or None) → stacked device limb arrays."""
+def g1_to_device(points: Sequence[Optional[tuple]], rep=F) -> Tuple:
+    """Host Jacobian G1 points (or None) → stacked device limb arrays.
+
+    ``rep`` selects the device representation module: :mod:`fp381` (13-bit
+    limbs, default) or :mod:`fp381_mxu` (8-bit digits for the MXU ops)."""
     coords = ([], [], [])
     for p in points:
         for ci in range(3):
             coords[ci].append(0 if p is None else p[ci] % F.P)
-    return tuple(F.ints_to_limbs_batch(cs) for cs in coords)
+    return tuple(rep.ints_to_limbs_batch(cs) for cs in coords)
 
 
 def g1_from_device(pt) -> Optional[tuple]:
@@ -322,7 +440,7 @@ def g1_from_device(pt) -> Optional[tuple]:
     return (F.limbs_to_int(x) % F.P, F.limbs_to_int(y) % F.P, zi)
 
 
-def g2_to_device(points: Sequence[Optional[tuple]]) -> Tuple:
+def g2_to_device(points: Sequence[Optional[tuple]], rep=F) -> Tuple:
     """Host Jacobian G2 points (Fp2 coords) → device ((re,im) limb pairs)."""
     coords = ([], []), ([], []), ([], [])
     for p in points:
@@ -332,17 +450,17 @@ def g2_to_device(points: Sequence[Optional[tuple]]) -> Tuple:
             coords[ci][0].append(c[0] % F.P)
             coords[ci][1].append(c[1] % F.P)
     return tuple(
-        (F.ints_to_limbs_batch(re), F.ints_to_limbs_batch(im))
+        (rep.ints_to_limbs_batch(re), rep.ints_to_limbs_batch(im))
         for (re, im) in coords
     )
 
 
-def g1_from_device_batch(pt) -> list:
+def g1_from_device_batch(pt, rep=F) -> list:
     """Device (X, Y, Z) limb arrays with a leading batch axis → list of host
     Jacobian points (None = infinity).  Canonicalizes on host; one
     object-dtype matvec per coordinate instead of a per-point limb loop."""
     xs, ys, zs = (
-        F.limbs_to_ints_batch(np.asarray(c).reshape(-1, F.NL)) for c in pt
+        rep.limbs_to_ints_batch(np.asarray(c).reshape(-1, rep.NL)) for c in pt
     )
     return [
         None if (z % F.P) == 0 else (x % F.P, y % F.P, z % F.P)
@@ -350,12 +468,12 @@ def g1_from_device_batch(pt) -> list:
     ]
 
 
-def g2_from_device_batch(pt) -> list:
+def g2_from_device_batch(pt, rep=F) -> list:
     """Device G2 ((re, im) limb-pair coords, leading batch axis) → list of
     host Jacobian points (None = infinity)."""
     (xr, xi), (yr, yi), (zr, zi) = (
         tuple(
-            F.limbs_to_ints_batch(np.asarray(c).reshape(-1, F.NL))
+            rep.limbs_to_ints_batch(np.asarray(c).reshape(-1, rep.NL))
             for c in coord
         )
         for coord in pt
@@ -394,3 +512,5 @@ FP_OPS = _FpOps()
 FP2_OPS = _Fp2Ops()
 LAZY_FP_OPS = _LazyFpOps()
 LAZY_FP2_OPS = _LazyFp2Ops()
+MXU_FP_OPS = _MxuFpOps()
+MXU_FP2_OPS = _MxuFp2Ops()

@@ -226,9 +226,10 @@ class Broadcast(ConsensusProtocol):
         if prev is not None and prev.root_hash != root:
             return Step.from_fault(sender_id, FaultKind.EchoHashConflict)
         self.echo_hashes[sender_id] = root
-        step = self._maybe_send_ready(root)
-        step.extend(self._try_decode())
-        return step
+        # no _try_decode here: an EchoHash adds neither a shard nor a
+        # Ready, so it can only matter through the Ready threshold (and
+        # _handle_ready runs _try_decode itself)
+        return self._maybe_send_ready(root)
 
     def _handle_can_decode(self, sender_id: NodeId, root: bytes) -> Step:
         if sender_id in self.can_decodes:
@@ -249,7 +250,10 @@ class Broadcast(ConsensusProtocol):
         return step
 
     def _maybe_send_can_decode(self, root: bytes) -> Step:
-        """≥ N−2f full shards in hand → tell peers to stop sending shards."""
+        """≥ N−2f full shards in hand → tell peers to stop sending shards.
+
+        Sent only to peers whose full Echo has NOT already arrived — the
+        others have nothing left to withhold (reference sends AllExcept)."""
         step = Step()
         if (
             not self.can_decode_sent
@@ -257,7 +261,9 @@ class Broadcast(ConsensusProtocol):
             and self._count_echos(root) >= self.data_shard_num
         ):
             self.can_decode_sent = True
-            step.send_all(CanDecodeMsg(root))
+            step.send(
+                Target.all_except(set(self.echos)), CanDecodeMsg(root)
+            )
         return step
 
     def _handle_ready(self, sender_id: NodeId, root: bytes) -> Step:

@@ -147,7 +147,9 @@ class RandomAdversary(Adversary):
         from hbbft_tpu.protocols.binary_agreement import (
             AuxMsg, BValMsg, ConfMsg, TermMsg,
         )
-        from hbbft_tpu.protocols.broadcast import EchoMsg, ReadyMsg, ValueMsg
+        from hbbft_tpu.protocols.broadcast import (
+            CanDecodeMsg, EchoHashMsg, EchoMsg, ReadyMsg, ValueMsg,
+        )
 
         r = self.rng
         if isinstance(msg, (BValMsg, AuxMsg)):
@@ -160,10 +162,10 @@ class RandomAdversary(Adversary):
             return dataclasses.replace(
                 msg, values=frozenset([r.random() < 0.5])
             )
-        if isinstance(msg, ReadyMsg):
+        if isinstance(msg, (ReadyMsg, EchoHashMsg, CanDecodeMsg)):
             root = bytearray(msg.root)
             root[r.randrange(len(root))] ^= 1 << r.randrange(8)
-            return ReadyMsg(bytes(root))
+            return type(msg)(bytes(root))
         if isinstance(msg, (ValueMsg, EchoMsg)):
             proof = msg.proof
             value = bytearray(proof.value)

@@ -1,0 +1,194 @@
+"""MXU-formulated 8-bit-digit field vs the pure-Python host oracle.
+
+Contract under test: for inputs within the lazy invariant (49 int32 digits,
+each in [0, 256], arbitrary residue), every op returns digits within the
+invariant whose value is ≡ the exact field result (mod p).  Exactness is
+checked value-for-value — one wrong f32 rounding or carry anywhere breaks
+equality.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from hbbft_tpu.crypto import bls12_381 as H
+from hbbft_tpu.ops import fp381_mxu as M
+
+
+def _rand_digit_arrays(rng, b):
+    """Adversarial lazy inputs: uniform digits over the FULL invariant
+    [0, 256] (256 inclusive — unreachable from int conversion, reachable
+    from rough carries)."""
+    return rng.integers(0, 257, size=(b, M.NL)).astype(np.int32)
+
+
+def _vals(arr):
+    return [M.limbs_to_int(row) for row in np.asarray(arr)]
+
+
+def _check_invariant(arr):
+    a = np.asarray(arr)
+    assert a.min() >= 0 and a.max() <= 256, (a.min(), a.max())
+
+
+@pytest.mark.parametrize("op,ref", [
+    ("fp_mul", lambda x, y: x * y),
+    ("fp_add", lambda x, y: x + y),
+    ("fp_sub", lambda x, y: x - y),
+])
+def test_ops_exact_on_adversarial_lazy_inputs(op, ref):
+    rng = np.random.default_rng(hash(op) % 2**32)
+    B = 64
+    a = _rand_digit_arrays(rng, B)
+    b = _rand_digit_arrays(rng, B)
+    # mix in structured edges: zero, one, p-1, all-255, all-256
+    edges = np.stack([
+        M.int_to_limbs(0),
+        M.int_to_limbs(1),
+        M.int_to_limbs(H.P - 1),
+        np.full(M.NL, 255, dtype=np.int32),
+        np.full(M.NL, 256, dtype=np.int32),
+    ])
+    a[:5] = edges
+    b[:5] = edges[::-1]
+    out = jax.jit(getattr(M, op))(jnp.asarray(a), jnp.asarray(b))
+    _check_invariant(out)
+    av, bv, ov = _vals(a), _vals(b), _vals(out)
+    for i in range(B):
+        assert ov[i] % H.P == ref(av[i], bv[i]) % H.P, i
+
+
+def test_mul_composes_with_itself():
+    """Outputs feed back as inputs across a chain of muls (the ladder
+    regime): values must track the host product chain exactly."""
+    rng = np.random.default_rng(7)
+    B = 16
+    a = _rand_digit_arrays(rng, B)
+    cur = jnp.asarray(a)
+    host = [v % H.P for v in _vals(a)]
+    sq = jax.jit(M.fp_mul)
+    for _ in range(12):
+        cur = sq(cur, cur)
+        _check_invariant(cur)
+        host = [v * v % H.P for v in host]
+    got = _vals(cur)
+    for i in range(B):
+        assert got[i] % H.P == host[i], i
+
+
+def test_fp2_mul_sqr_exact():
+    rng = np.random.default_rng(11)
+    B = 16
+    ar, ai = _rand_digit_arrays(rng, B), _rand_digit_arrays(rng, B)
+    br, bi = _rand_digit_arrays(rng, B), _rand_digit_arrays(rng, B)
+    A = (jnp.asarray(ar), jnp.asarray(ai))
+    Bp = (jnp.asarray(br), jnp.asarray(bi))
+    mul = jax.jit(M.fp2_mul)(A, Bp)
+    sqr = jax.jit(M.fp2_sqr)(A)
+    for part in (*mul, *sqr):
+        _check_invariant(part)
+    arv, aiv = _vals(ar), _vals(ai)
+    brv, biv = _vals(br), _vals(bi)
+    mr, mi = _vals(mul[0]), _vals(mul[1])
+    sr, si = _vals(sqr[0]), _vals(sqr[1])
+    for i in range(B):
+        a2 = (arv[i] % H.P, aiv[i] % H.P)
+        b2 = (brv[i] % H.P, biv[i] % H.P)
+        em = H.fp2_mul(a2, b2)
+        es = H.fp2_sqr(a2)
+        assert (mr[i] % H.P, mi[i] % H.P) == em, i
+        assert (sr[i] % H.P, si[i] % H.P) == es, i
+
+
+def test_zero_propagates_digitwise():
+    """The explicit-infinity ladder needs exact digit-zero propagation
+    through mul (0·x = digit-zero)."""
+    rng = np.random.default_rng(13)
+    z = jnp.zeros((4, M.NL), dtype=jnp.int32)
+    x = jnp.asarray(_rand_digit_arrays(rng, 4))
+    out = jax.jit(M.fp_mul)(z, x)
+    assert np.asarray(out).max() == 0
+
+
+def test_g1_lazy_ladder_mxu_ops_matches_host():
+    """128-bit explicit-infinity ladder over the MXU field == host G1."""
+    import random
+
+    from hbbft_tpu.ops import fp381_mxu as MX
+    from hbbft_tpu.ops import gcurve as G
+
+    rng = random.Random(29)
+    B = 4
+    base = [H.g1_mul(H.G1_GEN, rng.randrange(1, H.R)) for _ in range(B - 1)]
+    base.append(None)  # an infinity in the batch
+    scalars = [rng.randrange(0, 1 << 64) for _ in range(B - 1)] + [5]
+    pts = tuple(jnp.asarray(c) for c in G.g1_to_device(base, rep=MX))
+    bits = jnp.asarray(G.scalars_to_bits(scalars, nbits=64))
+    base_inf = jnp.asarray(np.array([p is None for p in base]))
+    out, inf = jax.jit(
+        lambda p, b, i: G.scalar_mul_lazy(G.MXU_FP_OPS, p, b, i)
+    )(pts, bits, base_inf)
+    inf = np.asarray(inf)
+    host_pts = G.g1_from_device_batch(out, rep=MX)
+    for i in range(B):
+        expect = H.g1_mul(base[i], scalars[i])
+        if expect is None:
+            assert inf[i], i
+        else:
+            assert not inf[i], i
+            assert H.g1_eq(host_pts[i], expect), i
+
+
+def test_g2_lazy_ladder_mxu_ops_matches_host():
+    import random
+
+    from hbbft_tpu.ops import fp381_mxu as MX
+    from hbbft_tpu.ops import gcurve as G
+
+    rng = random.Random(31)
+    B = 2
+    base = [H.g2_mul(H.G2_GEN, rng.randrange(1, H.R)) for _ in range(B)]
+    scalars = [rng.randrange(1, 1 << 64) for _ in range(B)]
+    pts = tuple(
+        tuple(jnp.asarray(x) for x in c) for c in G.g2_to_device(base, rep=MX)
+    )
+    bits = jnp.asarray(G.scalars_to_bits(scalars, nbits=64))
+    base_inf = jnp.asarray(np.zeros(B, dtype=bool))
+    out, inf = jax.jit(
+        lambda p, b, i: G.scalar_mul_lazy(G.MXU_FP2_OPS, p, b, i)
+    )(pts, bits, base_inf)
+    assert not np.asarray(inf).any()
+    host_pts = G.g2_from_device_batch(out, rep=MX)
+    for i in range(B):
+        assert H.g2_eq(host_pts[i], H.g2_mul(base[i], scalars[i])), i
+
+
+def test_windowed_ladder_matches_bitwise_and_host():
+    """scalar_mul_lazy_window == scalar_mul_lazy == host, G1 MXU ops."""
+    import random
+
+    from hbbft_tpu.ops import fp381_mxu as MX
+    from hbbft_tpu.ops import gcurve as G
+
+    rng = random.Random(37)
+    B = 4
+    base = [H.g1_mul(H.G1_GEN, rng.randrange(1, H.R)) for _ in range(B - 1)]
+    base.append(None)
+    scalars = [rng.randrange(0, 1 << 64) for _ in range(B - 1)] + [9]
+    pts = tuple(jnp.asarray(c) for c in G.g1_to_device(base, rep=MX))
+    bits = jnp.asarray(G.scalars_to_bits(scalars, nbits=64))
+    base_inf = jnp.asarray(np.array([p is None for p in base]))
+    out_w, inf_w = jax.jit(
+        lambda p, b, i: G.scalar_mul_lazy_window(G.MXU_FP_OPS, p, b, i)
+    )(pts, bits, base_inf)
+    host_w = G.g1_from_device_batch(out_w, rep=MX)
+    inf_w = np.asarray(inf_w)
+    for i in range(B):
+        expect = H.g1_mul(base[i], scalars[i])
+        if expect is None:
+            assert inf_w[i], i
+        else:
+            assert not inf_w[i], i
+            assert H.g1_eq(host_w[i], expect), i

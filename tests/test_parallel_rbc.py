@@ -54,11 +54,14 @@ def run_object_rbc(n, values, value_mask, echo_mask, ready_mask):
     for p, v in enumerate(values):
         fan_out(p, p, inst[(p, p)].handle_input(v))
 
+    from hbbft_tpu.protocols.broadcast import EchoHashMsg
+
     while queue:
         src, dst, p, msg = queue.pop(0)
         if isinstance(msg, ValueMsg) and not value_mask[p][dst]:
             continue
-        if isinstance(msg, EchoMsg) and not echo_mask[src][dst][p]:
+        # EchoHash is the echo of that edge (hash-only form) — same mask
+        if isinstance(msg, (EchoMsg, EchoHashMsg)) and not echo_mask[src][dst][p]:
             continue
         if isinstance(msg, ReadyMsg) and not ready_mask[src][dst][p]:
             continue
