@@ -37,13 +37,28 @@ _RAND_BITS = 128
 _WINDOW_BITS = 132
 
 
-def _field_rep():
-    """Device field backend for the MSM ladders: the MXU 8-bit-digit field
-    (default — matmul limb products, smaller graphs) or the 13-bit VPU lazy
-    field (``HBBFT_FIELD_BACKEND=lazy``).  Both are exact; speed choice."""
+# Above this many ladder rows the MSM is compute-bound and the 13-bit VPU
+# field wins (its 900-MAC schoolbook limb product is ~100× lighter per value
+# than the MXU formulation's one-hot matmul); below it the run is
+# launch-bound and the MXU field's fewer/fused kernels win.  Measured
+# crossover on TPU v5e: coin256 (B=512) 2.1× faster on mxu, dkg256
+# (B=16384) 3.4× faster on lazy.
+MXU_MAX_BATCH = 2048
+
+
+def _field_rep(size: int):
+    """Device field backend for an MSM ladder of ``size`` rows.
+
+    ``HBBFT_FIELD_BACKEND=lazy|mxu`` forces one; default picks by batch
+    size (see MXU_MAX_BATCH).  Both are exact; speed choice only."""
     import os
 
-    if os.environ.get("HBBFT_FIELD_BACKEND", "mxu") == "lazy":
+    forced = os.environ.get("HBBFT_FIELD_BACKEND")
+    use_mxu = (
+        forced == "mxu"
+        or (forced is None and size <= MXU_MAX_BATCH)
+    )
+    if not use_mxu:
         from hbbft_tpu.ops import fp381 as rep
 
         return rep, G.LAZY_FP_OPS, G.LAZY_FP2_OPS
@@ -72,34 +87,49 @@ class _MsmCache:
             import jax
             import jax.numpy as jnp
 
-            rep, fp_ops, fp2_ops = _field_rep()
+            rep, fp_ops, fp2_ops = _field_rep(size)
+            # windowed ladder wins in the launch-bound small-batch regime;
+            # at large B its one-hot table selects cost more than the adds
+            # they save, so the plain bitwise ladder is faster there
+            lad = (
+                G.scalar_mul_lazy_window
+                if size <= MXU_MAX_BATCH
+                else G.scalar_mul_lazy
+            )
 
             def pack(flat, oinf):
-                # the inf flags ride as one extra int32 row so the result
-                # is ONE device→host transfer (each transfer is a full
-                # tunnel round-trip on the remote-chip setup)
+                # the inf flags ride as one extra row so the result is ONE
+                # device→host transfer, and everything ships as int16 (lazy
+                # digits fit: ≤ 2^13 for the 13-bit field, ≤ 2^8 for the
+                # MXU field) — transfers cross a bandwidth-limited tunnel
                 nl = flat.shape[-1]
                 inf_row = jnp.pad(
                     oinf.astype(flat.dtype)[:, None], ((0, 0), (0, nl - 1))
                 )
-                return jnp.concatenate([flat, inf_row[None]], 0)
+                return jnp.concatenate(
+                    [flat, inf_row[None]], 0
+                ).astype(jnp.int16)
 
             if group == "g1":
 
                 def ladder(stacked, b, inf):
+                    stacked = stacked.astype(jnp.int32)
+                    b = b.astype(jnp.int32)
                     pt = (stacked[0], stacked[1], stacked[2])
-                    out, oinf = G.scalar_mul_lazy_window(fp_ops, pt, b, inf)
+                    out, oinf = lad(fp_ops, pt, b, inf)
                     return pack(jnp.stack(out), oinf)
 
             else:
 
                 def ladder(stacked, b, inf):
+                    stacked = stacked.astype(jnp.int32)
+                    b = b.astype(jnp.int32)
                     pt = (
                         (stacked[0], stacked[1]),
                         (stacked[2], stacked[3]),
                         (stacked[4], stacked[5]),
                     )
-                    out, oinf = G.scalar_mul_lazy_window(fp2_ops, pt, b, inf)
+                    out, oinf = lad(fp2_ops, pt, b, inf)
                     flat = jnp.stack(
                         [out[0][0], out[0][1], out[1][0], out[1][1],
                          out[2][0], out[2][1]]
@@ -133,7 +163,10 @@ class _MsmCache:
             stacked = np.stack([
                 x for coord in G.g2_to_device(pts, rep=rep) for x in coord
             ])  # (6, B, NL)
-        bits = jnp.asarray(G.scalars_to_bits(sc, nbits=_WINDOW_BITS))
+        stacked = stacked.astype(np.int16)  # canonical limbs fit; 2× less
+        bits = jnp.asarray(
+            G.scalars_to_bits(sc, nbits=_WINDOW_BITS).astype(np.uint8)
+        )
         base_inf = jnp.asarray(np.array([p is None for p in pts]))
         packed = fn(jnp.asarray(stacked), bits, base_inf)
         return (group, rep, len(points), packed)
@@ -196,8 +229,10 @@ class _MsmCache:
         b = [s // c.LAMBDA_G1 for s in sc]
         phi = [c.g1_endo(p) for p in pts]
 
-        stacked = np.stack(G.g1_to_device(pts + phi, rep=rep))
-        bits = jnp.asarray(G.scalars_to_bits(a + b, nbits=_WINDOW_BITS))
+        stacked = np.stack(G.g1_to_device(pts + phi, rep=rep)).astype(np.int16)
+        bits = jnp.asarray(
+            G.scalars_to_bits(a + b, nbits=_WINDOW_BITS).astype(np.uint8)
+        )
         base_inf = jnp.asarray(np.array([p is None for p in pts] * 2))
         packed = np.asarray(fn(jnp.asarray(stacked), bits, base_inf))
 

@@ -99,8 +99,13 @@ _FOLD_ROWS = np.stack(
     [int_to_limbs((1 << (DIGIT_BITS * (NL + m))) % P) for m in range(_N_HI)]
 ).astype(np.float32)  # (_N_HI, NL)
 
-# squeeze fold row: 2^392 mod p
-_ROW392 = int_to_limbs((1 << (DIGIT_BITS * NL)) % P)
+# squeeze fold rows: 2^(8(NL+m)) mod p for the _CARRY_PAD overflow digits
+_SQUEEZE_ROWS = np.stack(
+    [
+        int_to_limbs((1 << (DIGIT_BITS * (NL + m))) % P)
+        for m in range(_CARRY_PAD)
+    ]
+)
 
 # ≡ −2·(2^392 − 1) (mod p), canonical — completes the digitwise complement
 # in fp_sub (same construction as fp381._SUBC_LIMBS in the 13-bit field)
@@ -127,19 +132,22 @@ def _carry_rough(t):
 def _squeeze(acc):
     """(…, NL) int32 limbs with values < 2^31 → lazy-invariant digits.
 
-    Appends carry room, rough-carries, then folds the top digit back
-    through 2^392 mod p; each fold with a nonzero top digit shrinks the
-    overhang by ≥ 2^11 (2^392 vs p < 2^381), so 3 rounds reach top 0 from
-    any value < 2^410 (mirrors fp381._squeeze_lazy)."""
+    Appends ``_CARRY_PAD`` carry positions (one is NOT enough: a single
+    appended digit's own carry would fall off the end for limbs ≥ 2^16),
+    rough-carries, then folds ALL overflow digits back through their
+    2^(8(NL+m)) mod p residue rows; each fold with a nonzero overhang
+    shrinks it by ≥ 2^11 (2^392 vs p < 2^381), so 3 rounds reach overhang
+    0 from any in-contract input (mirrors fp381._squeeze_lazy)."""
     import jax.numpy as jnp
 
-    row = jnp.asarray(_ROW392)
-    zero1 = jnp.zeros((*acc.shape[:-1], 1), acc.dtype)
-    acc = jnp.concatenate([acc, zero1], -1)
+    rows = jnp.asarray(_SQUEEZE_ROWS)
+    zero_pad = jnp.zeros((*acc.shape[:-1], _CARRY_PAD), acc.dtype)
+    acc = jnp.concatenate([acc, zero_pad], -1)
     acc = _carry_rough(acc)
     for _ in range(3):
-        top = acc[..., NL : NL + 1]
-        acc = jnp.concatenate([acc[..., :NL] + top * row, zero1], -1)
+        top = acc[..., NL:]
+        fold = jnp.einsum("...m,md->...d", top, rows)
+        acc = jnp.concatenate([acc[..., :NL] + fold, zero_pad], -1)
         acc = _carry_rough(acc)
     return acc[..., :NL]
 

@@ -192,3 +192,23 @@ def test_windowed_ladder_matches_bitwise_and_host():
         else:
             assert not inf_w[i], i
             assert H.g1_eq(host_w[i], expect), i
+
+
+def test_squeeze_handles_large_top_digit():
+    """Regression: a single appended carry position dropped the carry out
+    of digit NL for inputs with digit[NL-1] region values ≥ 2^16 — e.g.
+    value 2^400 as one huge digit.  The squeeze must be exact for any
+    in-contract input (every limb < 2^31)."""
+    rng = np.random.default_rng(17)
+    cases = np.zeros((4, M.NL), dtype=np.int64)
+    cases[0, M.NL - 1] = 1 << 24          # value 2^408
+    cases[1, M.NL - 1] = (1 << 31) - 1    # max limb at the top
+    cases[2] = rng.integers(0, 1 << 31, size=M.NL)  # dense max-magnitude
+    cases[3, 0] = (1 << 31) - 1
+    arr = jnp.asarray(cases.astype(np.int32))
+    out = jax.jit(M._squeeze)(arr)
+    _check_invariant(out)
+    got = _vals(out)
+    expect = [M.limbs_to_int(row) % H.P for row in cases]
+    for i in range(len(cases)):
+        assert got[i] % H.P == expect[i], i

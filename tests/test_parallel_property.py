@@ -27,7 +27,7 @@ from hypothesis import HealthCheck, given, settings  # noqa: E402
 from hypothesis import strategies as st  # noqa: E402
 
 from hbbft_tpu.parallel.aba import BatchedAba  # noqa: E402
-from hbbft_tpu.parallel.rbc import BatchedRbc, frame_values  # noqa: E402
+from hbbft_tpu.parallel.rbc import BatchedRbc  # noqa: E402
 
 from test_parallel_rbc import run_both, run_object_rbc  # noqa: E402
 
