@@ -629,10 +629,73 @@ CONFIGS = {
     "hb-epoch4096": bench_hb_epoch4096,
 }
 
+def sustained4096(epochs: int, n: int = 4096, tx_bytes: int = 64):
+    """Sustained multi-epoch N=4096 session (BASELINE config 5's real
+    role: examples/simulation.rs runs epoch after epoch, not one).  Prints
+    a per-epoch table + drift stats to stderr and ONE summary JSON line;
+    not part of --config all (several minutes of wall clock)."""
+    import random
+
+    from hbbft_tpu.netinfo import NetworkInfo
+    from hbbft_tpu.parallel.acs import BatchedHoneyBadgerEpoch
+
+    rng = random.Random(23)
+    print(f"# sustained: generating keys for N={n}…", file=sys.stderr)
+    infos = NetworkInfo.generate_map(list(range(n)), rng)
+    hb = BatchedHoneyBadgerEpoch(infos, session_id=b"sustained4096")
+    contribs = {
+        i: bytes(rng.randrange(256) for _ in range(tx_bytes)) for i in range(n)
+    }
+    times = []
+    for e in range(epochs):
+        t0 = time.perf_counter()
+        batch, _ = hb.run(
+            contribs, random.Random(100 + e), encrypt=True,
+            session_suffix=b"/e%d" % e,
+        )
+        dt = time.perf_counter() - t0
+        assert batch == contribs
+        times.append(dt)
+        print(f"# epoch {e}: {dt:.1f}s ({1.0 / dt:.4f} epochs/s)",
+              file=sys.stderr, flush=True)
+    warm = times[1:] if len(times) > 1 else times
+    line = {
+        "metric": "hb_epoch4096_sustained",
+        "value": round(1.0 / float(np.median(warm)), 4),
+        "unit": "epochs/s",
+        "vs_baseline": 0,
+        "epochs": epochs,
+        "t_first_s": round(times[0], 2),
+        "t_median_warm_s": round(float(np.median(warm)), 2),
+        "t_min_s": round(min(times), 2),
+        "t_max_s": round(max(times), 2),
+        "drift_pct": round(
+            100.0 * (warm[-1] - warm[0]) / warm[0], 1
+        ) if len(warm) > 1 else 0.0,
+        "shape": f"N={n} f={(n - 1) // 3} tx={tx_bytes}B",
+    }
+    print(json.dumps(line), flush=True)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", choices=[*CONFIGS, "all"], default="all")
+    ap.add_argument(
+        "--sustained", type=int, metavar="EPOCHS", default=0,
+        help="run a sustained N=4096 multi-epoch session instead of the "
+        "config pass (records per-epoch time + drift)",
+    )
     args = ap.parse_args(argv)
+
+    if args.sustained:
+        if args.sustained < 2:
+            ap.error("--sustained needs >= 2 epochs (epoch 0 is the "
+                     "compile epoch; warm stats need at least one more)")
+        from hbbft_tpu.util import enable_compilation_cache
+
+        enable_compilation_cache()
+        sustained4096(args.sustained)
+        return
 
     names = list(CONFIGS) if args.config == "all" else [args.config]
     results = []

@@ -54,9 +54,13 @@ def _field_rep(size: int):
     import os
 
     forced = os.environ.get("HBBFT_FIELD_BACKEND")
+    if forced not in (None, "", "mxu", "lazy"):
+        raise ValueError(
+            f"HBBFT_FIELD_BACKEND={forced!r}: expected 'mxu' or 'lazy'"
+        )
     use_mxu = (
         forced == "mxu"
-        or (forced is None and size <= MXU_MAX_BATCH)
+        or (not forced and size <= MXU_MAX_BATCH)
     )
     if not use_mxu:
         from hbbft_tpu.ops import fp381 as rep

@@ -138,7 +138,14 @@ def _g2_msm_case(nbits, s0, s1):
     )
 
 
+@pytest.mark.slow
 def test_g2_msm_ladder_and_tree():
+    """Slow-gated: the 13-bit-field Fp2 ladder body alone compiles for
+    minutes on the CPU backend (the persistent cache does not load there).
+    What keeps default coverage of the 13-bit field: test_fp2_ops_exact
+    (Fp2 ops), test_lazy_g1_msm_packed_path below (the lazy G1 ladder
+    through the production packed-MSM path), and the MXU-field G2 ladder
+    (tests/test_fp381_mxu.py) for the G2 point formulas."""
     rng = random.Random(17)
     _g2_msm_case(64, rng.randrange(1, 1 << 64), (1 << 64) - 1)
 
@@ -148,3 +155,36 @@ def test_g2_msm_ladder_full_width():
     rng = random.Random(17)
     _g2_msm_case(G.R_BITS,
                  rng.randrange(1, H.R), H.R - 1)
+
+
+def test_lazy_g1_msm_packed_path():
+    """The PRODUCTION large-batch path — scalar_mul_lazy over the 13-bit
+    LAZY field with int16/uint8 packed I/O — at a small batch, forced via
+    HBBFT_FIELD_BACKEND=lazy on a fresh cache (the auto heuristic would
+    pick the MXU field at this size)."""
+    import os
+
+    from hbbft_tpu.crypto import batch as CB
+    from hbbft_tpu.crypto import bls12_381 as c
+
+    rng = random.Random(41)
+    pts = [c.g1_mul(c.G1_GEN, rng.randrange(1, c.R)) for _ in range(3)]
+    pts.append(None)
+    sc = [rng.randrange(1, 1 << 128) for _ in range(3)] + [7]
+    cache = CB._MsmCache()
+    old = os.environ.get("HBBFT_FIELD_BACKEND")
+    old_max = CB.MXU_MAX_BATCH
+    os.environ["HBBFT_FIELD_BACKEND"] = "lazy"
+    CB.MXU_MAX_BATCH = 0  # also forces the BITWISE (large-batch) ladder
+    try:
+        got = cache._msm("g1", pts, sc)
+    finally:
+        CB.MXU_MAX_BATCH = old_max
+        if old is None:
+            os.environ.pop("HBBFT_FIELD_BACKEND", None)
+        else:
+            os.environ["HBBFT_FIELD_BACKEND"] = old
+    expect = None
+    for p, s in zip(pts, sc):
+        expect = c.g1_add(expect, c.g1_mul(p, s))
+    assert c.g1_eq(got, expect)
