@@ -647,34 +647,61 @@ def sustained4096(epochs: int, n: int = 4096, tx_bytes: int = 64):
         i: bytes(rng.randrange(256) for _ in range(tx_bytes)) for i in range(n)
     }
     times = []
-    for e in range(epochs):
-        t0 = time.perf_counter()
-        batch, _ = hb.run(
-            contribs, random.Random(100 + e), encrypt=True,
-            session_suffix=b"/e%d" % e,
-        )
-        dt = time.perf_counter() - t0
-        assert batch == contribs
-        times.append(dt)
-        print(f"# epoch {e}: {dt:.1f}s ({1.0 / dt:.4f} epochs/s)",
-              file=sys.stderr, flush=True)
-    warm = times[1:] if len(times) > 1 else times
-    line = {
-        "metric": "hb_epoch4096_sustained",
-        "value": round(1.0 / float(np.median(warm)), 4),
-        "unit": "epochs/s",
-        "vs_baseline": 0,
-        "epochs": epochs,
-        "t_first_s": round(times[0], 2),
-        "t_median_warm_s": round(float(np.median(warm)), 2),
-        "t_min_s": round(min(times), 2),
-        "t_max_s": round(max(times), 2),
-        "drift_pct": round(
-            100.0 * (warm[-1] - warm[0]) / warm[0], 1
-        ) if len(warm) > 1 else 0.0,
-        "shape": f"N={n} f={(n - 1) // 3} tx={tx_bytes}B",
-    }
-    print(json.dumps(line), flush=True)
+    interrupted = None
+
+    def emit():
+        # one JSON line whatever happened — a driver timeout mid-session
+        # must not erase the completed epochs (same contract as the
+        # config pass)
+        line = {
+            "metric": "hb_epoch4096_sustained",
+            "value": 0,
+            "unit": "epochs/s",
+            "vs_baseline": 0,
+            "epochs": len(times),
+            "epochs_requested": epochs,
+            "shape": f"N={n} f={(n - 1) // 3} tx={tx_bytes}B",
+        }
+        if times:
+            warm = times[1:] if len(times) > 1 else times
+            line.update({
+                "value": round(1.0 / float(np.median(warm)), 4),
+                "t_first_s": round(times[0], 2),
+                "t_median_warm_s": round(float(np.median(warm)), 2),
+                "t_min_s": round(min(times), 2),
+                "t_max_s": round(max(times), 2),
+                "drift_pct": round(
+                    100.0 * (warm[-1] - warm[0]) / warm[0], 1
+                ) if len(warm) > 1 else 0.0,
+            })
+        if interrupted is not None:
+            line["interrupted"] = interrupted
+        print(json.dumps(line), flush=True)
+
+    import signal
+
+    def on_term(signum, frame):
+        nonlocal interrupted
+        interrupted = signum
+        raise SystemExit(128 + signum)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, on_term)
+
+    try:
+        for e in range(epochs):
+            t0 = time.perf_counter()
+            batch, _ = hb.run(
+                contribs, random.Random(100 + e), encrypt=True,
+                session_suffix=b"/e%d" % e,
+            )
+            dt = time.perf_counter() - t0
+            assert batch == contribs
+            times.append(dt)
+            print(f"# epoch {e}: {dt:.1f}s ({1.0 / dt:.4f} epochs/s)",
+                  file=sys.stderr, flush=True)
+    finally:
+        emit()
 
 
 def main(argv=None):

@@ -72,10 +72,18 @@ def _field_rep(size: int):
 
 
 class _MsmCache:
-    """Jitted MSM launchers per (group, padded batch size)."""
+    """Jitted MSM launchers per (group, padded batch size).
 
-    def __init__(self):
+    With ``mesh`` set, every ladder runs ``shard_map``-ped with its batch
+    (row) axis sharded over the mesh — the MSM rows are independent, so the
+    crypto phase of an epoch scales across chips with no collectives; the
+    host fold sees the gathered result exactly as in the single-device
+    case.  ``use_mesh(mesh)`` swaps the module-global cache.
+    """
+
+    def __init__(self, mesh=None):
         self._fns = {}
+        self.mesh = mesh
 
     def _get(self, group: str, size: int):
         # one jitted LADDER per (group, padded size); the final fold over
@@ -140,6 +148,20 @@ class _MsmCache:
                     )
                     return pack(flat, oinf)
 
+            if self.mesh is not None and size % self.mesh.devices.size == 0:
+                from jax import shard_map
+                from jax.sharding import PartitionSpec as P
+
+                axes = tuple(self.mesh.axis_names)
+                ladder = shard_map(
+                    ladder,
+                    mesh=self.mesh,
+                    # rows (the batch axis) shard over the mesh; there is
+                    # no cross-row communication inside a ladder
+                    in_specs=(P(None, axes), P(axes), P(axes)),
+                    out_specs=P(None, axes),
+                    check_vma=False,
+                )
             self._fns[key] = (jax.jit(ladder), rep)
         return self._fns[key]
 
@@ -253,7 +275,19 @@ class _MsmCache:
         return res
 
 
-_CACHE = _MsmCache()
+_CACHES: Dict[Optional[object], _MsmCache] = {}
+_CACHE = _CACHES.setdefault(None, _MsmCache())
+
+
+def use_mesh(mesh) -> None:
+    """Route all MSM ladders through ``mesh`` (row-sharded ``shard_map``;
+    see :class:`_MsmCache`).  Pass ``None`` to return to single-device.
+    Caches are kept per mesh, so toggling back and forth never re-pays
+    ladder compiles (minutes each on the CPU backend)."""
+    global _CACHE
+    if mesh not in _CACHES:
+        _CACHES[mesh] = _MsmCache(mesh=mesh)
+    _CACHE = _CACHES[mesh]
 
 
 # --------------------------------------------------------------------------

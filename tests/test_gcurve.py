@@ -142,10 +142,13 @@ def _g2_msm_case(nbits, s0, s1):
 def test_g2_msm_ladder_and_tree():
     """Slow-gated: the 13-bit-field Fp2 ladder body alone compiles for
     minutes on the CPU backend (the persistent cache does not load there).
-    What keeps default coverage of the 13-bit field: test_fp2_ops_exact
-    (Fp2 ops), test_lazy_g1_msm_packed_path below (the lazy G1 ladder
-    through the production packed-MSM path), and the MXU-field G2 ladder
-    (tests/test_fp381_mxu.py) for the G2 point formulas."""
+    Default coverage of the 13-bit field's COMPONENTS: test_fp2_ops_exact
+    (Fp2 ops), test_lazy_g1_msm_packed_path (lazy field + bitwise ladder +
+    packed I/O), MXU-field G2 ladder (tests/test_fp381_mxu.py — G2 point
+    formulas).  The exact lazy-Fp2×G2×bitwise COMPOSITION — the production
+    path for G2 MSM batches > MXU_MAX_BATCH — is only exercised under
+    --slow (here and test_lazy_g2_msm_packed_path); run --slow before
+    touching fp381's lazy Fp2 ops or the ladder."""
     rng = random.Random(17)
     _g2_msm_case(64, rng.randrange(1, 1 << 64), (1 << 64) - 1)
 
@@ -188,3 +191,34 @@ def test_lazy_g1_msm_packed_path():
     for p, s in zip(pts, sc):
         expect = c.g1_add(expect, c.g1_mul(p, s))
     assert c.g1_eq(got, expect)
+
+
+@pytest.mark.slow
+def test_lazy_g2_msm_packed_path():
+    """--slow: the exact production composition for LARGE G2 MSM batches —
+    lazy 13-bit Fp2 field × bitwise ladder × packed int16/uint8 I/O."""
+    import os
+
+    from hbbft_tpu.crypto import batch as CB
+    from hbbft_tpu.crypto import bls12_381 as c
+
+    rng = random.Random(59)
+    pts = [c.g2_mul(c.G2_GEN, rng.randrange(1, c.R)) for _ in range(3)]
+    sc = [rng.randrange(1, 1 << 128) for _ in range(3)]
+    cache = CB._MsmCache()
+    old = os.environ.get("HBBFT_FIELD_BACKEND")
+    old_max = CB.MXU_MAX_BATCH
+    os.environ["HBBFT_FIELD_BACKEND"] = "lazy"
+    CB.MXU_MAX_BATCH = 0
+    try:
+        got = cache._msm("g2", pts, sc)
+    finally:
+        CB.MXU_MAX_BATCH = old_max
+        if old is None:
+            os.environ.pop("HBBFT_FIELD_BACKEND", None)
+        else:
+            os.environ["HBBFT_FIELD_BACKEND"] = old
+    expect = None
+    for p, s in zip(pts, sc):
+        expect = c.g2_add(expect, c.g2_mul(p, s))
+    assert c.g2_eq(got, expect)

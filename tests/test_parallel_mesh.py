@@ -194,3 +194,57 @@ def test_sharded_full_hb_epoch_matches_single_device(mesh8):
     assert batch_m == batch_s
     np.testing.assert_array_equal(out_m["accepted"], out_s["accepted"])
     assert out_m["epochs"] == out_s["epochs"]
+
+
+def test_sharded_msm_matches_single_device_and_host(mesh8):
+    """The batch-verify/decrypt MSM ladders row-sharded over the mesh:
+    same results as single-device and the host oracle."""
+    import random
+
+    from hbbft_tpu.crypto import batch as CB
+    from hbbft_tpu.crypto import bls12_381 as c
+
+    rng = random.Random(43)
+    B = 8  # pads to 8 = one row per device
+    pts = [c.g1_mul(c.G1_GEN, rng.randrange(1, c.R)) for _ in range(B - 1)]
+    pts.append(None)
+    sc = [rng.randrange(1, 1 << 128) for _ in range(B - 1)] + [11]
+
+    single = CB._MsmCache()._msm("g1", pts, sc)
+    sharded = CB._MsmCache(mesh=mesh8)._msm("g1", pts, sc)
+    expect = None
+    for p, s in zip(pts, sc):
+        expect = c.g1_add(expect, c.g1_mul(p, s))
+    assert c.g1_eq(single, expect)
+    assert c.g1_eq(sharded, expect)
+
+
+def test_sharded_batch_verify_and_decrypt(mesh8):
+    """use_mesh() routes the whole crypto phase (share batch-verify and
+    TPKE decrypt) over the mesh; results equal the single-device path."""
+    import random
+
+    from hbbft_tpu.crypto import batch as CB
+    from hbbft_tpu.crypto.tc import SecretKeySet
+
+    rng = random.Random(47)
+    n, f = 8, 2
+    sks = SecretKeySet.random(f, rng)
+    pks = sks.public_keys()
+    msg = b"mesh-coin"
+    pairs = [
+        (pks.public_key_share(i), sks.secret_key_share(i).sign(msg))
+        for i in range(n)
+    ]
+    ct = pks.public_key().encrypt(b"mesh secret", rng)
+    shares = [(i, sks.secret_key_share(i)) for i in range(f + 1)]
+
+    CB.use_mesh(mesh8)
+    try:
+        assert CB.batch_verify_sig_shares(pairs, msg, rng) is True
+        forged = list(pairs)
+        forged[3] = (pairs[3][0], sks.secret_key_share(3).sign(b"z"))
+        assert CB.batch_verify_sig_shares(forged, msg, rng) is False
+        assert CB.batch_tpke_decrypt(pks, [ct], shares) == [b"mesh secret"]
+    finally:
+        CB.use_mesh(None)
