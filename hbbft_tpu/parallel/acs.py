@@ -138,6 +138,28 @@ class BatchedHoneyBadgerEpoch:
         self.session_id = session_id
         self.acs = BatchedAcs(self.n, self.f, mesh=mesh)
 
+    def encrypt_phase(self, contributions: Dict, rng,
+                      encrypt: bool = True) -> List[bytes]:
+        """The host-side TPKE encrypt of every proposer's contribution.
+
+        Split out so epoch pipelines can run it for epoch e+1 (host/native
+        work, GIL released inside the C oracle) while epoch e's ACS drives
+        the device — the §2.3 epoch-axis (PP) overlap.  Returns the
+        per-proposer payload list for :meth:`run_from_payloads` (ciphertext
+        bytes when encrypting; accepted payloads are re-parsed at decrypt
+        time, so nothing else needs the Ciphertext objects)."""
+        pks = self.netinfo_map[self.ids[0]].public_key_set()
+        payloads: List[bytes] = []
+        for nid in self.ids:
+            contrib = contributions.get(nid, b"")
+            if encrypt:
+                payloads.append(
+                    pks.public_key().encrypt(contrib, rng).to_bytes()
+                )
+            else:
+                payloads.append(contrib)
+        return payloads
+
     def run(self, contributions: Dict, rng, encrypt: bool = True,
             session_suffix: bytes = b"", **rbc_kwargs):
         """contributions: {node_id: bytes}.  Returns (batch, detail): the
@@ -149,22 +171,20 @@ class BatchedHoneyBadgerEpoch:
         HoneyBadger's ``session_id + "/hb-epoch/" + epoch`` subset naming,
         so coin values never repeat across epochs.  Host-side only: no
         recompilation."""
+        payloads = self.encrypt_phase(contributions, rng, encrypt)
+        return self.run_from_payloads(
+            payloads, encrypt=encrypt,
+            session_suffix=session_suffix, **rbc_kwargs,
+        )
+
+    def run_from_payloads(self, payloads, encrypt: bool = True,
+                          session_suffix: bytes = b"", **rbc_kwargs):
+        """ACS + threshold-decrypt over pre-encrypted payloads (see
+        :meth:`encrypt_phase`)."""
         from hbbft_tpu.crypto import tc
 
         info0 = self.netinfo_map[self.ids[0]]
         pks = info0.public_key_set()
-        payloads: List[bytes] = []
-        cts = []
-        for nid in self.ids:
-            contrib = contributions.get(nid, b"")
-            if encrypt:
-                ct = pks.public_key().encrypt(contrib, rng)
-                cts.append(ct)
-                payloads.append(ct.to_bytes())
-            else:
-                cts.append(None)
-                payloads.append(contrib)
-
         session = self.session_id + session_suffix
 
         def coin_fn(p, e):

@@ -192,3 +192,31 @@ def test_batched_epoch_deterministic():
     assert b1 == b2 == contribs
     for k in ("accepted", "delivered", "data"):
         np.testing.assert_array_equal(np.asarray(d1[k]), np.asarray(d2[k]))
+
+
+def test_batched_qhb_pipelined_epochs_commit_once():
+    """Epoch-axis overlap (§2.3 PP): the pipelined driver — epoch e+1's
+    TPKE encrypt on a worker thread while epoch e's ACS runs — commits
+    every injected transaction exactly once, like the sequential driver."""
+    import random
+
+    from hbbft_tpu.parallel.qhb import BatchedQueueingHoneyBadger
+
+    n = 4
+    infos = infos_for(n)
+    qhb = BatchedQueueingHoneyBadger(
+        infos, batch_size=6, session_id=b"pipelined-qhb"
+    )
+    txs = [b"ptx-%03d" % i for i in range(36)]
+    rng = random.Random(71)
+    for i, tx in enumerate(txs):
+        qhb.push(qhb.ids[i % n], tx)
+
+    total = 0
+    epochs = 0
+    while qhb.pending() > 0 and epochs < 16:
+        total += qhb.run_epochs_pipelined(rng, 2)
+        epochs += 2
+    assert qhb.pending() == 0, "queue not drained"
+    assert sorted(qhb.committed) == sorted(txs)      # exactly once each
+    assert total == len(txs)
