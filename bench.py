@@ -489,7 +489,8 @@ def _bench_hb_epoch_large(n: int, tx_bytes: int, iters: int, tag: str):
     contribs = {
         i: bytes(rng.randrange(256) for _ in range(tx_bytes)) for i in range(n)
     }
-    hb = BatchedHoneyBadgerEpoch(infos, session_id=tag.encode())
+    hb = BatchedHoneyBadgerEpoch(infos, session_id=tag.encode(),
+                                 compact=True)
     batch0, _ = hb.run(contribs, random.Random(1), encrypt=True)  # compile
     assert batch0 == contribs
     times = []
@@ -642,7 +643,8 @@ def sustained4096(epochs: int, n: int = 4096, tx_bytes: int = 64):
     rng = random.Random(23)
     print(f"# sustained: generating keys for N={n}…", file=sys.stderr)
     infos = NetworkInfo.generate_map(list(range(n)), rng)
-    hb = BatchedHoneyBadgerEpoch(infos, session_id=b"sustained4096")
+    hb = BatchedHoneyBadgerEpoch(infos, session_id=b"sustained4096",
+                                 compact=True)
     contribs = {
         i: bytes(rng.randrange(256) for _ in range(tx_bytes)) for i in range(n)
     }

@@ -63,11 +63,13 @@ class BatchedAcs:
             )
             self._aba_step = jax.jit(self.aba.epoch_step)
 
+
     def run(
         self,
         values: Sequence[bytes],
         coin_fn=None,
         max_epochs: int = 24,
+        compact: bool = False,
         **rbc_kwargs,
     ):
         """values[p] = proposer p's contribution.  Returns a dict with
@@ -77,6 +79,15 @@ class BatchedAcs:
         coin_fn(p, epoch) -> bool supplies the threshold-coin values for
         the random epochs (default: a deterministic hash — fine for tests;
         the simulator passes `aba.coin_for` over real key shares).
+
+        ``compact=True`` returns only what an epoch driver needs —
+        ``accepted_row`` (P,), ``accepted_agree``/``delivered_ok`` flags,
+        and per-instance ``data_sel`` (P, k, B) from a delivering receiver.
+        The (N, P) decision array reduces on device (its ~16 MB at N=4096
+        would otherwise cross the bandwidth-limited link); delivered/data
+        are host arrays already on the large-N RBC path, so the rest
+        reduces in numpy.  Compact mode requires a data row per receiver
+        and refuses ``receivers=``-bounded RBC calls.
         """
         import jax
         import jax.numpy as jnp
@@ -108,6 +119,38 @@ class BatchedAcs:
             st = step(st, coins)
             epochs += 1
 
+        if compact:
+            if "receivers" in rbc_kwargs:
+                raise ValueError(
+                    "compact mode needs a data row per receiver; it cannot "
+                    "be combined with a receivers=-bounded RBC call"
+                )
+            decision = st["decision"]
+            # the (N, P) decision array stays on device: only its first row
+            # and the agreement scalar cross the link (the large-N RBC path
+            # already returns delivered/data as host arrays, so everything
+            # else reduces in numpy for free)
+            row = np.asarray(decision[0])
+            agree = bool(np.asarray(
+                (decision == decision[0][None, :]).all()
+            ))
+            delivered_np = np.asarray(delivered)
+            any_deliv = delivered_np.any(axis=0)
+            delivered_ok = bool((~row | any_deliv).all())
+            src = delivered_np.argmax(axis=0)      # first delivering node
+            recv = np.asarray(out["data_receivers"])
+            inv = np.zeros(n, dtype=np.int32)
+            inv[recv] = np.arange(len(recv), dtype=np.int32)
+            data_np = np.asarray(out["data"])
+            data_sel = data_np[inv[src], np.arange(len(src))]
+            return {
+                "accepted_row": row,
+                "accepted_agree": agree,
+                "delivered_ok": delivered_ok,
+                "data_sel": data_sel,
+                "epochs": epochs,
+            }
+
         return {
             "accepted": np.asarray(st["decision"]),
             "delivered": np.asarray(delivered),
@@ -128,7 +171,7 @@ class BatchedHoneyBadgerEpoch:
     """
 
     def __init__(self, netinfo_map: Dict, session_id: bytes = b"batched-hb",
-                 mesh=None):
+                 mesh=None, compact: bool = False):
         ids = sorted(netinfo_map.keys(), key=repr)
         self.ids = ids
         self.netinfo_map = netinfo_map
@@ -136,6 +179,10 @@ class BatchedHoneyBadgerEpoch:
         self.n = info0.num_nodes()
         self.f = info0.num_faulty()
         self.session_id = session_id
+        # compact: device-side ACS result reduction (see BatchedAcs.run) —
+        # the epoch drivers at scale enable it; the default keeps the full
+        # detail arrays that cross-mode equality tests compare
+        self.compact = compact
         self.acs = BatchedAcs(self.n, self.f, mesh=mesh)
 
     def encrypt_phase(self, contributions: Dict, rng,
@@ -190,37 +237,58 @@ class BatchedHoneyBadgerEpoch:
         def coin_fn(p, e):
             return coin_for(self.netinfo_map, session, self.ids[p], e)
 
-        out = self.acs.run(payloads, coin_fn=coin_fn, **rbc_kwargs)
+        out = self.acs.run(
+            payloads, coin_fn=coin_fn, compact=self.compact, **rbc_kwargs
+        )
         # what the RBC actually broadcast (ciphertext bytes when encrypting)
         # — cost models need this, not the plaintext length
         out["payload_bytes"] = max((len(p) for p in payloads), default=0)
-        accepted = out["accepted"]
-        delivered = out["delivered"]
-        # agreement across correct nodes is asserted by callers/tests; use
-        # node 0's accepted row, but take each value from a receiver that
-        # actually DELIVERED it (rbc data is valid only where delivered —
-        # under partial masks node 0 may have voted 1 from others' echoes)
-        row = accepted[0]
         batch: Dict = {}
         t = pks.threshold()
-        # map delivering receivers to rows in the data array once
-        # (the full-delivery fast path returns one shared row)
-        row_of = {int(r): i for i, r in enumerate(out["data_receivers"])}
         pending: List[Tuple] = []  # (nid, payload)
+        # each mode provides framed(p): the framed value of accepted
+        # instance p, taken from a receiver that actually DELIVERED it
+        # (rbc data is valid only where delivered — under partial masks
+        # node 0 may have voted 1 from others' echoes)
+        if self.compact:
+            row = out["accepted_row"]
+            if not out["accepted_agree"]:
+                raise RuntimeError("nodes disagree on the accepted set")
+            if not out["delivered_ok"]:
+                raise RuntimeError(
+                    "an accepted instance has no delivering node"
+                )
+
+            def framed(p):
+                return out["data_sel"][p]
+
+        else:
+            # agreement across correct nodes is asserted by callers/tests
+            row = out["accepted"][0]
+            delivered = out["delivered"]
+            # map delivering receivers to rows in the data array once
+            # (the full-delivery fast path returns one shared row)
+            row_of = {int(r): i for i, r in enumerate(out["data_receivers"])}
+
+            def framed(p):
+                deliverers = np.flatnonzero(delivered[:, p])
+                if deliverers.size == 0:
+                    raise RuntimeError(
+                        f"instance {p} accepted but no node delivered its value"
+                    )
+                rows = [
+                    row_of[int(d)] for d in deliverers if int(d) in row_of
+                ]
+                if not rows:
+                    raise RuntimeError(
+                        f"instance {p}: no delivering receiver has a data row"
+                    )
+                return out["data"][rows[0], p]
+
         for p, nid in enumerate(self.ids):
             if not row[p]:
                 continue
-            deliverers = np.flatnonzero(delivered[:, p])
-            if deliverers.size == 0:
-                raise RuntimeError(
-                    f"instance {p} accepted but no node delivered its value"
-                )
-            rows = [row_of[int(d)] for d in deliverers if int(d) in row_of]
-            if not rows:
-                raise RuntimeError(
-                    f"instance {p}: no delivering receiver has a data row"
-                )
-            payload = unframe_value(out["data"][rows[0], p])
+            payload = unframe_value(framed(p))
             if payload is None:
                 continue
             if encrypt:

@@ -32,7 +32,9 @@ class BatchedQueueingHoneyBadger:
     def __init__(self, netinfo_map: Dict, batch_size: int = 100,
                  session_id: bytes = b"batched-qhb", encrypt: bool = True,
                  cost_model=None):
-        self.hb = BatchedHoneyBadgerEpoch(netinfo_map, session_id=session_id)
+        self.hb = BatchedHoneyBadgerEpoch(
+            netinfo_map, session_id=session_id, compact=True
+        )
         self.ids = self.hb.ids
         self.batch_size = batch_size
         self.encrypt = encrypt

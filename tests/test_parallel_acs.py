@@ -220,3 +220,50 @@ def test_batched_qhb_pipelined_epochs_commit_once():
     assert qhb.pending() == 0, "queue not drained"
     assert sorted(qhb.committed) == sorted(txs)      # exactly once each
     assert total == len(txs)
+
+
+@pytest.mark.parametrize("encrypt", [True, False], ids=["tpke", "plain"])
+def test_compact_epoch_equals_full(encrypt):
+    """compact=True (device-side ACS reduction) must produce the identical
+    Batch to the full-detail mode."""
+    import random
+
+    n = 4
+    infos = infos_for(n)
+    contribs = {i: bytes([65 + i]) * (4 + i) for i in range(n)}
+    full = BatchedHoneyBadgerEpoch(infos, session_id=b"compact-cmp")
+    b_full, d_full = full.run(dict(contribs), random.Random(9),
+                              encrypt=encrypt)
+    comp = BatchedHoneyBadgerEpoch(infos, session_id=b"compact-cmp",
+                                   compact=True)
+    b_comp, d_comp = comp.run(dict(contribs), random.Random(9),
+                              encrypt=encrypt)
+    assert b_comp == b_full == contribs
+    assert d_comp["epochs"] == d_full["epochs"]
+    np.testing.assert_array_equal(
+        d_comp["accepted_row"], d_full["accepted"][0]
+    )
+
+
+def test_compact_epoch_equals_full_under_masks():
+    """The compact path's receiver→row mapping and argmax-deliverer
+    selection on PER-RECEIVER data rows (the masked, non-shared-row case)."""
+    import random
+
+    import jax.numpy as jnp_
+
+    n = 4
+    infos = infos_for(n)
+    contribs = {i: b"masked-%d" % i * (i + 2) for i in range(n)}
+    rng = np.random.default_rng(12)
+    em = ~(rng.random((n, n, n)) < 0.25)
+    for i in range(n):
+        em[i, i, :] = True
+    kw = dict(echo_mask=jnp_.asarray(em))
+
+    full = BatchedHoneyBadgerEpoch(infos, session_id=b"mask-cmp")
+    b_f, _ = full.run(dict(contribs), random.Random(5), **kw)
+    comp = BatchedHoneyBadgerEpoch(infos, session_id=b"mask-cmp",
+                                   compact=True)
+    b_c, _ = comp.run(dict(contribs), random.Random(5), **kw)
+    assert b_c == b_f
