@@ -94,12 +94,13 @@ class _MsmCache:
         # checks are sound (see ops/fp381.py); host fold canonicalizes.
         # I/O is ONE stacked array each way: per-coordinate transfers cost a
         # full tunnel round-trip each (~100 ms) on the remote-chip setup.
-        key = (group, size)
+        rep, fp_ops, fp2_ops = _field_rep(size)
+        # the resolved backend is part of the key: flipping
+        # HBBFT_FIELD_BACKEND mid-process must not serve a stale ladder
+        key = (group, size, rep.__name__)
         if key not in self._fns:
             import jax
             import jax.numpy as jnp
-
-            rep, fp_ops, fp2_ops = _field_rep(size)
             # windowed ladder wins in the launch-bound small-batch regime;
             # at large B its one-hot table selects cost more than the adds
             # they save, so the plain bitwise ladder is faster there
@@ -330,11 +331,21 @@ def batch_tpke_decrypt(pks, cts, secret_shares):
         masks = _CACHE.g1_mul_batch(
             [ct.u for ct in cts], [master] * len(cts)
         )
+        mask_bytes = [c.g1_to_bytes(m) for m in masks]
     else:
-        masks = [c.g1_mul(ct.u, master) for ct in cts]
+        nat = c._native()
+        if nat is not None:
+            # one C call for the whole batch (GLV ladders, GIL released)
+            mask_bytes = nat.bls_tpke_mask_batch(
+                master, [c.g1_to_bytes(ct.u) for ct in cts]
+            )
+        else:
+            mask_bytes = [
+                c.g1_to_bytes(c.g1_mul(ct.u, master)) for ct in cts
+            ]
     out = []
-    for ct, mask in zip(cts, masks):
-        stream = tc._kdf_stream(c.g1_to_bytes(mask), len(ct.v))
+    for ct, mb in zip(cts, mask_bytes):
+        stream = tc._kdf_stream(mb, len(ct.v))
         out.append(bytes(a ^ b for a, b in zip(ct.v, stream)))
     return out
 

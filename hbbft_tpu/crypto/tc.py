@@ -76,6 +76,33 @@ def _hash_ciphertext_point(u, v: bytes):
     return c.hash_g2(b"HBBFT-TPKE" + c.g1_to_bytes(u) + v)
 
 
+def tpke_encrypt_batch(pk: "PublicKey", msgs: Sequence[bytes], rng) -> List["Ciphertext"]:
+    """Encrypt many contributions to one threshold key.
+
+    Draws one scalar per message from ``rng`` and is byte-identical to
+    sequential ``pk.encrypt(msg, rng)`` calls (tests assert it).  With the
+    native oracle present the WHOLE batch is one C call — the GIL is
+    released throughout, so the epoch pipeline's encrypt-ahead thread
+    overlaps with device work for real (parallel/qhb.py), and the per-item
+    cost drops to the endomorphism fast paths (fixed-base U, windowed
+    pk^r, ψ-based hash-to-G2, GLS W) instead of 4+ per-op oracle round
+    trips.  This is the batched-device-encrypt lever of SURVEY §3.1's HOT
+    encrypt row."""
+    rs = [rng.randrange(1, R) for _ in msgs]
+    nat = c._native()
+    if nat is not None:
+        out = nat.bls_tpke_encrypt_batch(
+            pk.to_bytes(), [bytes(m) for m in msgs], rs
+        )
+        return [
+            Ciphertext(
+                c._g1_from_bytes_trusted(u), v, c._g2_from_bytes_trusted(w)
+            )
+            for (u, v, w) in out
+        ]
+    return [pk._encrypt_with_r(m, r) for m, r in zip(msgs, rs)]
+
+
 # --------------------------------------------------------------------------
 # Plain keys (per-node; DHB votes, SyncKeyGen row encryption)
 # --------------------------------------------------------------------------
@@ -136,7 +163,9 @@ class PublicKey:
 
     def encrypt(self, msg: bytes, rng) -> "Ciphertext":
         """Hybrid encryption to this key (TPKE-shaped: (U, V, W))."""
-        r = rng.randrange(1, R)
+        return self._encrypt_with_r(msg, rng.randrange(1, R))
+
+    def _encrypt_with_r(self, msg: bytes, r: int) -> "Ciphertext":
         u = c.g1_mul(c.G1_GEN, r)
         mask = c.g1_mul(self.point, r)
         v = bytes(

@@ -22,6 +22,17 @@
 extern "C" void hbbft_sha3_256(const uint8_t* data, int64_t len,
                                uint8_t* out32);
 
+#if defined(__x86_64__) && defined(__ADX__) && defined(__BMI2__)
+// ADX/BMI2 dual-carry-chain Montgomery mul (bls381_mont.S) — ~4× the
+// __int128 C fallback below, which stays as its differential-test oracle.
+// The guard ties dispatch to the BUILD host's features; the library is
+// always built on the machine that runs it (first-use make in oracle.py).
+#define HBBFT_MONT_ASM 1
+extern "C" void hbbft_mont_mul_384(uint64_t* out, const uint64_t* a,
+                                   const uint64_t* b, const uint64_t* p,
+                                   uint64_t n0);
+#endif
+
 namespace bls {
 
 typedef unsigned __int128 u128;
@@ -120,6 +131,12 @@ struct Mod {
 
   // CIOS Montgomery multiplication
   void mul(const u64* a, const u64* b, u64* out) const {
+#ifdef HBBFT_MONT_ASM
+    if constexpr (N == 6) {
+      hbbft_mont_mul_384(out, a, b, p, n0);
+      return;
+    }
+#endif
     u64 t[N + 2];
     memset(t, 0, sizeof(t));
     for (int i = 0; i < N; ++i) {
@@ -192,6 +209,8 @@ static Fp2 GAMMA_M[6];
 static Fp2 B2_M;       // 4(u+1) in Montgomery
 static u64 B1_M[6];    // 4
 static u64 HALF_M[6];  // 1/2
+static Fp2 PSI_CX_M, PSI_CY_M;  // ψ endomorphism constants (Montgomery)
+static u64 GLV_BETA_M[6];       // G1 endomorphism β (Montgomery)
 
 static void init_all() {
   if (g_init) return;
@@ -209,6 +228,11 @@ static void init_all() {
   memcpy(B2_M.a, B1_M, sizeof(B1_M));
   memcpy(B2_M.b, B1_M, sizeof(B1_M));
   FP.from_raw(BLS_HALF, HALF_M);
+  FP.from_raw(BLS_PSI_CX[0], PSI_CX_M.a);
+  FP.from_raw(BLS_PSI_CX[1], PSI_CX_M.b);
+  FP.from_raw(BLS_PSI_CY[0], PSI_CY_M.a);
+  FP.from_raw(BLS_PSI_CY[1], PSI_CY_M.b);
+  FP.from_raw(BLS_GLV_BETA, GLV_BETA_M);
   g_init = true;
 }
 
@@ -279,6 +303,54 @@ static void f2_scal_small(const Fp2& x, int k, Fp2& o) {
   o = acc;
 }
 
+// Jacobi symbol of a (Montgomery in) over p — binary algorithm on raw
+// limbs, ~1000× cheaper than the Euler-criterion pow.  Used as the QR
+// pre-test in hash-to-curve: χ_Fp2(g) = jacobi(norm(g), p), so losing
+// try-and-increment candidates cost no field exponentiations.
+static int jacobi_m(const u64* a_m) {
+  u64 a[6], n[6];
+  FP.to_raw(a_m, a);      // a < p already
+  memcpy(n, FP.p, sizeof(n));
+  int t = 1;
+  auto is_one = [](const u64* x) {
+    if (x[0] != 1) return false;
+    for (int i = 1; i < 6; ++i)
+      if (x[i]) return false;
+    return true;
+  };
+  auto shr1 = [](u64* x) {
+    for (int i = 0; i < 5; ++i) x[i] = (x[i] >> 1) | (x[i + 1] << 63);
+    x[5] >>= 1;
+  };
+  while (!Mod<6>::is_zero(a)) {
+    while (!(a[0] & 1)) {
+      shr1(a);
+      u64 r8 = n[0] & 7;
+      if (r8 == 3 || r8 == 5) t = -t;
+    }
+    if ((a[0] & 3) == 3 && (n[0] & 3) == 3) t = -t;
+    u64 tmp[6];
+    memcpy(tmp, a, sizeof(tmp));
+    memcpy(a, n, sizeof(a));
+    memcpy(n, tmp, sizeof(n));  // swap; now reduce a mod n (n odd, a < 2^384)
+    while (Mod<6>::cmp(a, n) >= 0) {
+      // subtract the largest n·2^s ≤ a (binary reduction, O(384) total)
+      u64 t2[6];
+      memcpy(t2, n, sizeof(t2));
+      while (true) {
+        u64 t3[6];
+        bool of = t2[5] >> 63;
+        for (int i = 5; i > 0; --i) t3[i] = (t2[i] << 1) | (t2[i - 1] >> 63);
+        t3[0] = t2[0] << 1;
+        if (of || Mod<6>::cmp(t3, a) > 0) break;
+        memcpy(t2, t3, sizeof(t2));
+      }
+      Mod<6>::raw_sub(a, t2, a);
+    }
+  }
+  return is_one(n) ? t : 0;
+}
+
 static bool fp_sqrt(const u64* a, u64* out) {  // Montgomery in/out
   u64 r[6], chk[6];
   FP.pow(a, BLS_SQRT_EXP, 6, r);
@@ -320,6 +392,9 @@ static bool f2_sqrt(const Fp2& x, Fp2& o) {  // mirrors host fp2_sqrt
       FP.neg(s, sg);
     FP.add(x.a, sg, half);
     FP.mul(half, HALF_M, half);
+    // Jacobi pre-test picks the working sign branch without paying a
+    // full exponentiation on the losing one (χ((a±s)/2) decides)
+    if (jacobi_m(half) != 1) continue;
     if (!fp_sqrt(half, alpha) || Mod<6>::is_zero(alpha)) continue;
     u64 denom[6], dinv[6], beta[6];
     FP.add(alpha, alpha, denom);
@@ -723,6 +798,342 @@ static void g2_affine(const G2& pt, G2& o) {
 }
 
 // ---------------------------------------------------------------------------
+// endomorphism fast paths (mirrors crypto/bls12_381.py: g2_psi,
+// g2_clear_cofactor; crypto/batch.py: the GLV split).  ψ acts as [p] ≡ [X]
+// (mod r) on G2 and φ as [λ] on G1, so full-range scalars split into 64/128-
+// bit digit ladders.  PRECONDITION for the *_glv/*_gls muls: the input point
+// lies in the r-order subgroup (guaranteed by the Python layer — wire
+// deserialization subgroup-checks, and hash outputs are cofactor-cleared);
+// the exported generic bls_g1_mul/bls_g2_mul stay plain ladders because the
+// Python subgroup checks themselves route through them.
+// ---------------------------------------------------------------------------
+
+static void g2_psi(const G2& pt, G2& o) {
+  if (pt.inf) {
+    o = pt;
+    return;
+  }
+  Fp2 xc, yc, zc;
+  f2_conj(pt.x, xc);
+  f2_conj(pt.y, yc);
+  f2_conj(pt.z, zc);
+  o.inf = false;
+  f2_mul(PSI_CX_M, xc, o.x);
+  f2_mul(PSI_CY_M, yc, o.y);
+  o.z = zc;
+}
+
+static void g2_neg_pt(const G2& pt, G2& o) {
+  o = pt;
+  if (!pt.inf) f2_neg(pt.y, o.y);
+}
+
+static void g1_endo(const G1& pt, G1& o) {  // φ(X,Y,Z) = (β·X, Y, Z)
+  o = pt;
+  if (!pt.inf) FP.mul(GLV_BETA_M, pt.x, o.x);
+}
+
+// [|x|]P — 64-bit ladder (x = BLS parameter, negative; callers negate)
+static void g2_mul_xabs(const G2& pt, G2& o) {
+  u64 k = BLS_X_ABS;
+  g2_mul_limbs(pt, &k, 1, o);
+}
+
+// Budroni–Pintore cofactor clearing: [x²−x−1]P + [x−1]ψ(P) + ψ²([2]P).
+// Valid for ANY point of E'(Fp2); image lies in G2.  Two 64-bit ladders
+// replace the naive 512-bit [h₂] multiplication (~8× fewer point ops).
+static void g2_clear_cofactor(const G2& pt, G2& o) {
+  if (pt.inf) {
+    o = pt;
+    return;
+  }
+  G2 a, b, t1, t2, t3, neg;
+  g2_mul_xabs(pt, a);
+  g2_neg_pt(a, a);  // [x]P
+  g2_mul_xabs(a, b);
+  g2_neg_pt(b, b);  // [x²]P
+  g2_neg_pt(a, neg);
+  g2_add(b, neg, t1);
+  g2_neg_pt(pt, neg);
+  g2_add(t1, neg, t1);  // [x²−x−1]P
+  g2_add(a, neg, t2);
+  g2_psi(t2, t2);  // [x−1]ψ(P)
+  g2_double(pt, t3);
+  g2_psi(t3, t3);
+  g2_psi(t3, t3);  // ψ²([2]P)
+  g2_add(t1, t2, o);
+  g2_add(o, t3, o);
+}
+
+// -- small bignum helpers for the scalar decompositions ---------------------
+
+// mag (4 limbs) divmod u64: returns remainder, quotient in-place
+static u64 divmod_u64(u64* mag, u64 d) {
+  u128 rem = 0;
+  for (int i = 3; i >= 0; --i) {
+    u128 cur = (rem << 64) | mag[i];
+    mag[i] = (u64)(cur / d);
+    rem = cur % d;
+  }
+  return (u64)rem;
+}
+
+static bool mag_is_zero(const u64* m) {
+  return !(m[0] | m[1] | m[2] | m[3]);
+}
+
+// GLS digits: k (raw, < r) = d0 + x·(d1 + x·(d2 + x·(d3 + x·d4))), all
+// d_i ∈ [0, |x|) (d4 ∈ {0, 1} in practice — |x|⁴ > r−... the alternating-
+// sign division makes every digit non-negative; verified exhaustively in
+// the Python design check).  Returns false only if k fails to terminate in
+// 5 digits (never for k < r; defensive).
+static bool gls_digits(const u64* kraw4, u64 d[5]) {
+  u64 mag[4];
+  memcpy(mag, kraw4, sizeof(mag));
+  bool neg = false;
+  for (int i = 0; i < 5; ++i) {
+    u64 rem = divmod_u64(mag, BLS_X_ABS);
+    if (!neg) {
+      // v ≥ 0: d = rem; v' = −(v − d)/|x| (quotient already in mag)
+      d[i] = rem;
+      neg = !mag_is_zero(mag);
+    } else {
+      // v < 0 (mag holds |v|): d = (|x| − rem) mod |x|; v' = (|v| + d)/|x|
+      if (rem == 0) {
+        d[i] = 0;
+      } else {
+        d[i] = BLS_X_ABS - rem;
+        // (|v| + d) = (quot·|x| + rem + |x| − rem) = (quot + 1)·|x|
+        u64 carry = 1;
+        for (int j = 0; j < 4 && carry; ++j) {
+          mag[j] += carry;
+          carry = (mag[j] == 0);
+        }
+      }
+      neg = false;
+    }
+  }
+  return mag_is_zero(mag);
+}
+
+// wNAF-3 recoding of a 64-bit value: signed digits in {0, ±1, ±3}, average
+// nonzero density 1/4.  out must hold 66 entries; returns digit count.
+static int wnaf3(u64 k, int8_t* out) {
+  int n = 0;
+  while (k) {
+    if (k & 1) {
+      int d = (int)(k & 7);
+      if (d > 4) d -= 8;  // d ∈ {−3, −1, 1, 3}
+      out[n++] = (int8_t)d;
+      k -= (u64)((int64_t)d);
+    } else {
+      out[n++] = 0;
+    }
+    k >>= 1;
+  }
+  return n;
+}
+
+// [k]P for P ∈ G2, k raw 4-limb < r: ψ-Horner as one joint wNAF-3 ladder
+// over Q_i = ψ^i(P) — ~64 doubles + ~80 signed adds vs the generic
+// ladder's 512 doubles + ~256 adds (≈ 5× fewer point operations).
+static void g2_mul_gls(const G2& pt, const u64* kraw4, G2& o) {
+  if (pt.inf) {
+    o = pt;
+    return;
+  }
+  u64 d[5];
+  if (!gls_digits(kraw4, d)) {  // defensive fallback; unreachable for k < r
+    g2_mul_limbs(pt, kraw4, 4, o);
+    return;
+  }
+  G2 q1[5], q3[5];  // ψ^i(P) and 3·ψ^i(P)
+  q1[0] = pt;
+  for (int i = 1; i < 5; ++i) g2_psi(q1[i - 1], q1[i]);
+  for (int i = 0; i < 5; ++i) {
+    G2 t2;
+    g2_double(q1[i], t2);
+    g2_add(t2, q1[i], q3[i]);
+  }
+  int8_t naf[5][66];
+  int len = 0;
+  for (int i = 0; i < 5; ++i) {
+    int n = wnaf3(d[i], naf[i]);
+    for (int j = n; j < 66; ++j) naf[i][j] = 0;
+    if (n > len) len = n;
+  }
+  G2 acc;
+  acc.inf = true;
+  for (int b = len - 1; b >= 0; --b) {
+    g2_double(acc, acc);
+    for (int i = 0; i < 5; ++i) {
+      int8_t dg = naf[i][b];
+      if (!dg) continue;
+      G2 t = (dg == 1 || dg == -1) ? q1[i] : q3[i];
+      if (dg < 0) g2_neg_pt(t, t);
+      g2_add(acc, t, acc);
+    }
+  }
+  o = acc;
+}
+
+// [k]P for P ∈ G1, k raw 4-limb < r: GLV split k = a + b·λ (both < 2^128)
+// as one joint 128-bit ladder over P, φ(P).
+static void g1_mul_glv(const G1& pt, const u64* kraw4, G1& o) {
+  if (pt.inf) {
+    o = pt;
+    return;
+  }
+  // divide k by λ (2-limb) via binary shift-subtract: ~130 cheap word ops
+  u64 rem[4];
+  memcpy(rem, kraw4, sizeof(rem));
+  u64 a[2] = {0, 0}, bq[2] = {0, 0};
+  int lam_bits = 127;
+  while (!((BLS_GLV_LAMBDA[lam_bits / 64] >> (lam_bits % 64)) & 1)) --lam_bits;
+  for (int sh = 255 - lam_bits; sh >= 0; --sh) {
+    // t = λ << sh (5 limbs to be safe)
+    u64 t[5] = {0};
+    int w = sh / 64, s = sh % 64;
+    for (int i = 0; i < 2; ++i) {
+      t[i + w] |= s ? (BLS_GLV_LAMBDA[i] << s) : BLS_GLV_LAMBDA[i];
+      if (s) t[i + w + 1] |= BLS_GLV_LAMBDA[i] >> (64 - s);
+    }
+    // rem >= t ?
+    bool ge = true;
+    if (t[4]) ge = false;
+    if (ge) {
+      for (int i = 3; i >= 0; --i) {
+        if (rem[i] != t[i]) {
+          ge = rem[i] > t[i];
+          break;
+        }
+      }
+    }
+    if (ge) {
+      u128 br = 0;
+      for (int i = 0; i < 4; ++i) {
+        u128 dd = (u128)rem[i] - t[i] - br;
+        rem[i] = (u64)dd;
+        br = (dd >> 64) & 1;
+      }
+      bq[sh / 64] |= 1ULL << (sh % 64);
+    }
+  }
+  memcpy(a, rem, sizeof(a));  // a = k mod λ < 2^127, b = k / λ < 2^128
+
+  // joint wNAF-3 ladder over P, φ(P) (and their 3-multiples): ~128 doubles
+  // + ~64 signed adds vs the naive 256 doubles + ~128 adds
+  G1 base[2], base3[2];
+  base[0] = pt;
+  g1_endo(pt, base[1]);
+  for (int i = 0; i < 2; ++i) {
+    G1 t2;
+    g1_double(base[i], t2);
+    g1_add(t2, base[i], base3[i]);
+  }
+  // 128-bit wNAF-3: recode (lo, hi) limb pairs
+  auto wnaf128 = [](u64 lo, u64 hi, int8_t* out) {
+    int n = 0;
+    while (lo | hi) {
+      if (lo & 1) {
+        int d = (int)(lo & 7);
+        if (d > 4) d -= 8;
+        out[n++] = (int8_t)d;
+        u64 old = lo;
+        lo -= (u64)((int64_t)d);
+        if ((int64_t)d < 0 && lo < old) ++hi;       // carry on += wrap
+        if ((int64_t)d > 0 && lo > old) --hi;       // borrow on −= wrap
+      } else {
+        out[n++] = 0;
+      }
+      lo = (lo >> 1) | (hi << 63);
+      hi >>= 1;
+    }
+    return n;
+  };
+  int8_t naf[2][131];
+  int len = 0;
+  u64 sc[2][2] = {{a[0], a[1]}, {bq[0], bq[1]}};
+  for (int i = 0; i < 2; ++i) {
+    int n = wnaf128(sc[i][0], sc[i][1], naf[i]);
+    for (int j = n; j < 131; ++j) naf[i][j] = 0;
+    if (n > len) len = n;
+  }
+  G1 acc;
+  acc.inf = true;
+  for (int b = len - 1; b >= 0; --b) {
+    g1_double(acc, acc);
+    for (int i = 0; i < 2; ++i) {
+      int8_t dg = naf[i][b];
+      if (!dg) continue;
+      G1 t = (dg == 1 || dg == -1) ? base[i] : base3[i];
+      if (dg < 0) FP.neg(t.y, t.y);
+      g1_add(acc, t, acc);
+    }
+  }
+  o = acc;
+}
+
+// -- fixed-base tables -------------------------------------------------------
+
+static void load_gen(G1& gen) {
+  gen.inf = false;
+  FP.from_raw(BLS_G1_X, gen.x);
+  FP.from_raw(BLS_G1_Y, gen.y);
+  memcpy(gen.z, FP.one, sizeof(FP.one));
+}
+
+// generator table: T[w·255 + d−1] = [d·2^{8w}]·G, w ∈ 0..31, d ∈ 1..255 —
+// a fixed-base mul is ≤ 31 additions (thread-safe lazy build: magic static)
+static const std::vector<G1>& gen_table() {
+  static const std::vector<G1> table = [] {
+    std::vector<G1> t(32 * 255);
+    G1 base;
+    load_gen(base);
+    for (int w = 0; w < 32; ++w) {
+      t[w * 255] = base;
+      for (int d = 2; d <= 255; ++d)
+        g1_add(t[w * 255 + d - 2], base, t[w * 255 + d - 1]);
+      for (int i = 0; i < 8; ++i) g1_double(base, base);
+    }
+    return t;
+  }();
+  return table;
+}
+
+static void g1_mul_gen(const u64* kraw4, G1& o) {
+  const std::vector<G1>& t = gen_table();
+  o.inf = true;
+  for (int w = 0; w < 32; ++w) {
+    int d = (int)((kraw4[w / 8] >> ((w % 8) * 8)) & 0xFF);
+    if (d) g1_add(o, t[w * 255 + d - 1], o);
+  }
+}
+
+// per-call window-4 table for an arbitrary base (used by the batched TPKE
+// encrypt for pk^r: 960 build adds amortize over the batch, 63 adds/mul)
+struct G1Win4 {
+  std::vector<G1> t;  // [w·15 + d−1] = [d·2^{4w}]·P, w ∈ 0..63
+  void build(const G1& p) {
+    t.resize(64 * 15);
+    G1 base = p;
+    for (int w = 0; w < 64; ++w) {
+      t[w * 15] = base;
+      for (int d = 2; d <= 15; ++d)
+        g1_add(t[w * 15 + d - 2], base, t[w * 15 + d - 1]);
+      for (int i = 0; i < 4; ++i) g1_double(base, base);
+    }
+  }
+  void mul(const u64* kraw4, G1& o) const {
+    o.inf = true;
+    for (int w = 0; w < 64; ++w) {
+      int d = (int)((kraw4[w / 16] >> ((w % 16) * 4)) & 0xF);
+      if (d) g1_add(o, t[w * 15 + d - 1], o);
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
 // serialization (host format: tag byte + big-endian affine coords)
 // ---------------------------------------------------------------------------
 
@@ -1009,6 +1420,14 @@ static void hash_g2_point(const uint8_t* data, int64_t len, G2& out) {
     f2_sqr(x, t);
     f2_mul(t, x, rhs);
     f2_add(rhs, B2_M, rhs);
+    // QR pre-test: χ_Fp2(g) = jacobi(norm(g)) — losing try-and-increment
+    // candidates cost ~µs instead of field exponentiations.  Same ctr is
+    // selected as before (norm = 0 ⟺ rhs = 0 ⟺ y = 0, also rejected).
+    u64 nrm[6], tb[6];
+    FP.sqr(rhs.a, nrm);
+    FP.sqr(rhs.b, tb);
+    FP.add(nrm, tb, nrm);
+    if (jacobi_m(nrm) != 1) continue;
     Fp2 y;
     if (!f2_sqrt(rhs, y) || f2_is_zero(y)) continue;
     uint8_t sg[32];
@@ -1020,7 +1439,7 @@ static void hash_g2_point(const uint8_t* data, int64_t len, G2& out) {
     pt.y = y;
     pt.z = FP2_ONE_;
     G2 cleared;
-    g2_mul_limbs(pt, BLS_H2, BLS_H2_LIMBS, cleared);
+    g2_clear_cofactor(pt, cleared);  // ψ-based (mirrors host hash_g2)
     if (!cleared.inf) {
       out = cleared;
       return;
@@ -1042,6 +1461,7 @@ static void hash_g1_point(const uint8_t* data, int64_t len, G1& out) {
     FP.sqr(x, t);
     FP.mul(t, x, rhs);
     FP.add(rhs, B1_M, rhs);
+    if (jacobi_m(rhs) != 1) continue;  // QR pre-test (same ctr selected)
     u64 y[6];
     if (!fp_sqrt(rhs, y) || Mod<6>::is_zero(y)) continue;
     uint8_t sg[32];
@@ -1053,7 +1473,10 @@ static void hash_g1_point(const uint8_t* data, int64_t len, G1& out) {
     memcpy(pt.y, y, sizeof(y));
     memcpy(pt.z, FP.one, sizeof(FP.one));
     G1 cleared;
-    g1_mul_limbs(pt, BLS_H1, 2, cleared);
+    // effective cofactor 1−x (64-bit) in place of the 125-bit h₁ — the
+    // standard G1 clearing (RFC 9380 §8.8.1); mirrors host hash_g1
+    u64 heff = BLS_X_ABS + 1;
+    g1_mul_limbs(pt, &heff, 1, cleared);
     if (!cleared.inf) {
       out = cleared;
       return;
@@ -1179,7 +1602,7 @@ void bls_sign(const uint8_t* msg, int64_t len, const uint8_t* sk_be32,
   fr_from_be32(sk_be32, k);
   FR.from_raw(k, km);
   FR.to_raw(km, kr);
-  g2_mul_limbs(h, kr, 4, sig);
+  g2_mul_gls(h, kr, sig);  // h is a hash output → in G2
   g2_write(sig, out_sig);
 }
 
@@ -1214,7 +1637,7 @@ int bls_combine_g2(const uint32_t* idx, const uint8_t* shares193, int count,
     if (!g2_read(shares193 + 193 * i, s)) return -1;
     u64 lr[4];
     FR.to_raw(lams[i].data(), lr);
-    g2_mul_limbs(s, lr, 4, t);
+    g2_mul_gls(s, lr, t);  // shares are wire-subgroup-checked upstream
     g2_add(acc, t, acc);
   }
   g2_write(acc, out193);
@@ -1233,7 +1656,7 @@ int bls_combine_g1(const uint32_t* idx, const uint8_t* shares97, int count,
     if (!g1_read(shares97 + 97 * i, s)) return -1;
     u64 lr[4];
     FR.to_raw(lams[i].data(), lr);
-    g1_mul_limbs(s, lr, 4, t);
+    g1_mul_glv(s, lr, t);  // shares are wire-subgroup-checked upstream
     g1_add(acc, t, acc);
   }
   g1_write(acc, out97);
@@ -1265,18 +1688,14 @@ int bls_tpke_encrypt(const uint8_t* pk97, const uint8_t* msg, int64_t len,
                      const uint8_t* r_be32, uint8_t* out_u97, uint8_t* out_v,
                      uint8_t* out_w193) {
   init_all();
-  G1 pk, gen, u, mask;
+  G1 pk, u, mask;
   if (!g1_read(pk97, pk)) return -1;
-  gen.inf = false;
-  FP.from_raw(BLS_G1_X, gen.x);
-  FP.from_raw(BLS_G1_Y, gen.y);
-  memcpy(gen.z, FP.one, sizeof(FP.one));
   u64 k[4], km[4], kr[4];
   fr_from_be32(r_be32, k);
   FR.from_raw(k, km);
   FR.to_raw(km, kr);
-  g1_mul_limbs(gen, kr, 4, u);
-  g1_mul_limbs(pk, kr, 4, mask);
+  g1_mul_gen(kr, u);      // fixed-base table: ≤ 31 adds
+  g1_mul_glv(pk, kr, mask);
   g1_write(u, out_u97);
   uint8_t mask_bytes[97];
   g1_write(mask, mask_bytes);
@@ -1290,7 +1709,7 @@ int bls_tpke_encrypt(const uint8_t* pk97, const uint8_t* msg, int64_t len,
   memcpy(hin.data() + 107, out_v, len);
   G2 h, w;
   hash_g2_point(hin.data(), (int64_t)hin.size(), h);
-  g2_mul_limbs(h, kr, 4, w);
+  g2_mul_gls(h, kr, w);  // hash output → in G2
   g2_write(w, out_w193);
   return 0;
 }
@@ -1325,6 +1744,102 @@ int bls_tpke_combine(const uint32_t* idx, const uint8_t* shares97, int count,
   std::vector<uint8_t> stream(vlen);
   kdf_stream(mask, vlen, stream.data());
   for (int64_t i = 0; i < vlen; ++i) out_msg[i] = v[i] ^ stream[i];
+  return 0;
+}
+
+// -- batch entry points (the HoneyBadger epoch hot loops: ONE ctypes call,
+// GIL released for the whole batch, per-call tables amortized) --------------
+
+// Encrypt `count` messages to one public key.  msgs: concatenated plaintext
+// bytes; lens[i] their lengths; rs: count×32 big-endian scalars (< r, drawn
+// by the caller's seeded RNG — byte-identical to per-item bls_tpke_encrypt
+// with the same r).  out: per item U(97) ‖ W(193) ‖ V(len_i), concatenated.
+int bls_tpke_encrypt_batch(const uint8_t* pk97, const uint8_t* msgs,
+                           const int64_t* lens, int count, const uint8_t* rs,
+                           uint8_t* out) {
+  init_all();
+  G1 pk;
+  if (!g1_read(pk97, pk)) return -1;
+  G1Win4 pk_tab;
+  bool use_tab = count >= 64;  // build cost ~960 adds vs 63 adds/mul saved
+  if (use_tab) pk_tab.build(pk);
+  const uint8_t* mp = msgs;
+  uint8_t* op = out;
+  for (int i = 0; i < count; ++i) {
+    int64_t len = lens[i];
+    u64 k[4], km[4], kr[4];
+    fr_from_be32(rs + 32 * i, k);
+    FR.from_raw(k, km);
+    FR.to_raw(km, kr);
+    G1 u, mask;
+    g1_mul_gen(kr, u);
+    if (use_tab)
+      pk_tab.mul(kr, mask);
+    else
+      g1_mul_glv(pk, kr, mask);
+    uint8_t* u_out = op;
+    uint8_t* w_out = op + 97;
+    uint8_t* v_out = op + 290;
+    g1_write(u, u_out);
+    uint8_t mask_bytes[97];
+    g1_write(mask, mask_bytes);
+    std::vector<uint8_t> stream(len);
+    kdf_stream(mask_bytes, len, stream.data());
+    for (int64_t j = 0; j < len; ++j) v_out[j] = mp[j] ^ stream[j];
+    std::vector<uint8_t> hin(10 + 97 + len);
+    memcpy(hin.data(), "HBBFT-TPKE", 10);
+    memcpy(hin.data() + 10, u_out, 97);
+    memcpy(hin.data() + 107, v_out, len);
+    G2 h, w;
+    hash_g2_point(hin.data(), (int64_t)hin.size(), h);
+    g2_mul_gls(h, kr, w);
+    g2_write(w, w_out);
+    mp += len;
+    op += 290 + len;
+  }
+  return 0;
+}
+
+// masks[i] = [s]·U_i — the master-scalar fold of batched TPKE decryption
+// (crypto/batch.py::batch_tpke_decrypt host path).  U_i are wire-checked
+// subgroup members; s raw big-endian 32 bytes.
+int bls_tpke_mask_batch(const uint8_t* s_be32, const uint8_t* us97, int count,
+                        uint8_t* out97s) {
+  init_all();
+  u64 k[4], km[4], kr[4];
+  fr_from_be32(s_be32, k);
+  FR.from_raw(k, km);
+  FR.to_raw(km, kr);
+  for (int i = 0; i < count; ++i) {
+    G1 u, m;
+    if (!g1_read(us97 + 97 * i, u)) return -1;
+    g1_mul_glv(u, kr, m);
+    g1_write(m, out97s + 97 * i);
+  }
+  return 0;
+}
+
+// Common-coin batch: out_bits[i] = parity(SHA3(g2_bytes([s]·H_G2(nonce_i))))
+// — the master-scalar god-view fold of ThresholdSign (parallel/aba.py::
+// coin_for), one call for a whole epoch's instance axis.
+int bls_coin_batch(const uint8_t* s_be32, const uint8_t* nonces,
+                   const int64_t* lens, int count, uint8_t* out_bits) {
+  init_all();
+  u64 k[4], km[4], kr[4];
+  fr_from_be32(s_be32, k);
+  FR.from_raw(k, km);
+  FR.to_raw(km, kr);
+  const uint8_t* np = nonces;
+  for (int i = 0; i < count; ++i) {
+    G2 h, sig;
+    hash_g2_point(np, lens[i], h);
+    g2_mul_gls(h, kr, sig);
+    uint8_t sig_bytes[193], digest[32];
+    g2_write(sig, sig_bytes);
+    hbbft_sha3_256(sig_bytes, 193, digest);
+    out_bits[i] = digest[0] & 1;
+    np += lens[i];
+  }
   return 0;
 }
 

@@ -30,6 +30,7 @@ class NativeOracle:
             < max(
                 os.path.getmtime(os.path.join(_DIR, f))
                 for f in ("gf256.cpp", "keccak.cpp", "bls381.cpp",
+                          "bls381_mont.S", "Makefile",
                           "gen_bls_constants.py",
                           os.path.join("..", "crypto", "bls12_381.py"))
             )
@@ -60,6 +61,7 @@ class NativeOracle:
         u32p = ctypes.POINTER(ctypes.c_uint32)
         i = ctypes.c_int
         i64 = ctypes.c_int64
+        i64p = ctypes.POINTER(ctypes.c_int64)
         for name, args, res in [
             ("bls_g1_add", [u8p, u8p, u8p], i),
             ("bls_g1_mul", [u8p, u8p, u8p], i),
@@ -75,6 +77,9 @@ class NativeOracle:
             ("bls_tpke_encrypt", [u8p, u8p, i64, u8p, u8p, u8p, u8p], i),
             ("bls_tpke_verify", [u8p, u8p, i64, u8p], i),
             ("bls_tpke_combine", [u32p, u8p, i, u8p, i64, u8p], i),
+            ("bls_tpke_encrypt_batch", [u8p, u8p, i64p, i, u8p, u8p], i),
+            ("bls_tpke_mask_batch", [u8p, u8p, i, u8p], i),
+            ("bls_coin_batch", [u8p, u8p, i64p, i, u8p], i),
         ]:
             fn = getattr(lib, name)
             fn.argtypes = args
@@ -297,6 +302,53 @@ class NativeOracle:
             self._p(self._arr(v or b"\0")), len(v), self._p(out),
         ) == 0
         return out.tobytes()[: len(v)]
+
+    def bls_tpke_encrypt_batch(self, pk: bytes, msgs, rs) -> list:
+        """Encrypt many messages to one key in ONE native call (GIL released
+        for the whole batch; fixed-base/window tables amortized inside).
+        ``rs``: per-message scalars, byte-identical to per-item
+        ``bls_tpke_encrypt`` with the same r.  Returns [(u, v, w)]."""
+        lens = (ctypes.c_int64 * len(msgs))(*[len(m) for m in msgs])
+        cat = self._arr(b"".join(msgs) or b"\0")
+        rs_cat = self._arr(b"".join(r.to_bytes(32, "big") for r in rs))
+        total = sum(290 + len(m) for m in msgs)
+        out = self._buf(max(total, 1))
+        assert self._lib.bls_tpke_encrypt_batch(
+            self._p(self._arr(pk)), self._p(cat), lens, len(msgs),
+            self._p(rs_cat), self._p(out),
+        ) == 0
+        res, off, ob = [], 0, out.tobytes()
+        for m in msgs:
+            res.append(
+                (ob[off:off + 97], ob[off + 290:off + 290 + len(m)],
+                 ob[off + 97:off + 290])
+            )
+            off += 290 + len(m)
+        return res
+
+    def bls_tpke_mask_batch(self, scalar: int, us) -> list:
+        """[scalar]·U for each 97-byte U (the batched decrypt master-scalar
+        fold) in one native call."""
+        buf = np.concatenate([self._arr(u) for u in us])
+        out = self._buf(97 * len(us))
+        assert self._lib.bls_tpke_mask_batch(
+            self._p(self._arr(scalar.to_bytes(32, "big"))),
+            self._p(buf), len(us), self._p(out),
+        ) == 0
+        ob = out.tobytes()
+        return [ob[i * 97:(i + 1) * 97] for i in range(len(us))]
+
+    def bls_coin_batch(self, scalar: int, nonces) -> list:
+        """parity(SHA3(g2_bytes([scalar]·H_G2(nonce)))) per nonce — a whole
+        instance axis of common coins in one native call."""
+        lens = (ctypes.c_int64 * len(nonces))(*[len(n) for n in nonces])
+        cat = self._arr(b"".join(nonces) or b"\0")
+        out = self._buf(max(len(nonces), 1))
+        assert self._lib.bls_coin_batch(
+            self._p(self._arr(scalar.to_bytes(32, "big"))),
+            self._p(cat), lens, len(nonces), self._p(out),
+        ) == 0
+        return [bool(b) for b in out.tobytes()[: len(nonces)]]
 
 
 def get_oracle() -> NativeOracle:

@@ -123,7 +123,11 @@ def _shift1(c):
 
 
 def _carry_rough(t):
-    """3 rough passes: limbs < 2^31 → digits ≤ 256 (lazy invariant)."""
+    """3 rough passes over limbs < 2^31: digits land ≤ 384 (not yet the
+    ≤ 256 lazy invariant — from near-2^31 inputs three masked passes bound
+    each digit by 255 + carry-in ≤ 255 + 129).  The ≤ 256 invariant is
+    restored by the fold-round carries in :func:`_squeeze`, which always
+    follow; callers must not use these digits directly."""
     for _ in range(3):
         t = (t & MASK) + _shift1(t >> DIGIT_BITS)
     return t

@@ -92,8 +92,13 @@ class Broadcast(ConsensusProtocol):
         self.value_proof: Optional[Proof] = None
         self.echos: Dict[NodeId, Proof] = {}
         self.echo_hashes: Dict[NodeId, bytes] = {}  # shard-less echo evidence
-        self.can_decodes: Dict[NodeId, bytes] = {}  # peers that need no shard
-        self.can_decode_sent = False
+        # peers that need no shard, keyed per root hash on BOTH sides (as in
+        # the reference, which maps hash → senders): under an equivocating
+        # proposer an honest node may legitimately announce CanDecode for a
+        # losing root and later for the winning one — neither direction may
+        # suppress or fault that
+        self.can_decodes: Dict[NodeId, set] = {}
+        self.can_decode_sent: set = set()  # roots we announced
         self.readys: Dict[NodeId, bytes] = {}
         self.output: Optional[bytes] = None
         self.fault: bool = False  # proposer proven faulty (root mismatch)
@@ -188,8 +193,8 @@ class Broadcast(ConsensusProtocol):
             # that already announced CanDecode(root)
             root = proof.root_hash
             cd_peers = {
-                nid for nid, r in self.can_decodes.items()
-                if r == root and nid != self.our_id()
+                nid for nid, roots in self.can_decodes.items()
+                if root in roots and nid != self.our_id()
             }
             if cd_peers:
                 for nid in cd_peers:
@@ -232,11 +237,11 @@ class Broadcast(ConsensusProtocol):
         return self._maybe_send_ready(root)
 
     def _handle_can_decode(self, sender_id: NodeId, root: bytes) -> Step:
-        if sender_id in self.can_decodes:
-            if self.can_decodes[sender_id] == root:
-                return Step()
+        roots = self.can_decodes.setdefault(sender_id, set())
+        if root in roots:  # a repeat for the SAME root is the fault;
+            # distinct roots are legitimate under proposer equivocation
             return Step.from_fault(sender_id, FaultKind.MultipleCanDecodes)
-        self.can_decodes[sender_id] = root
+        roots.add(root)
         return Step()
 
     def _maybe_send_ready(self, root: bytes) -> Step:
@@ -256,11 +261,11 @@ class Broadcast(ConsensusProtocol):
         others have nothing left to withhold (reference sends AllExcept)."""
         step = Step()
         if (
-            not self.can_decode_sent
+            root not in self.can_decode_sent
             and not self.decided
             and self._count_echos(root) >= self.data_shard_num
         ):
-            self.can_decode_sent = True
+            self.can_decode_sent.add(root)
             step.send(
                 Target.all_except(set(self.echos)), CanDecodeMsg(root)
             )
