@@ -593,6 +593,22 @@ def g2_psi(pt):
     )
 
 
+def g2_in_subgroup(pt) -> bool:
+    """Eigenvalue subgroup test: ψ(P) == [x]P ⟺ P ∈ G2 (for on-curve P).
+
+    Soundness: ψ's characteristic equation ψ²−[t]ψ+[p] = 0 (t = x+1) turns
+    ψ(P) = [x]P into [p−x]P = ∞ with p−x = h₁·r, so ord(P) divides
+    gcd(h₁·r, h₂·r) = r·gcd(h₁, h₂) = r (gcd asserted in tests).  One ψ +
+    one 64-bit ladder replaces the [r−1] full-width check.
+    """
+    if pt is None:
+        return True
+    nat = _native()
+    if nat is not None:
+        return nat.bls_g2_in_subgroup(g2_to_bytes(pt))
+    return g2_eq(g2_psi(pt), g2_neg(g2_mul(pt, -X, mod_r=False)))
+
+
 def g2_clear_cofactor(pt):
     """Map any E'(Fp2) point into the r-order subgroup G2.
 
@@ -900,6 +916,21 @@ def _g2_from_bytes_trusted(data: bytes):
     return ((vals[0], vals[1]), (vals[2], vals[3]), FP2_ONE)
 
 
+def g1_in_subgroup(pt) -> bool:
+    """Eigenvalue subgroup test: φ(P) == [λ]P ⟺ P ∈ G1 (for on-curve P).
+
+    Soundness: φ satisfies φ²+φ+1 = 0 in End(E), so φ(P) = [λ]P forces
+    [λ²+λ+1]P = [r·k]P = ∞, and ord(P) | gcd(h₁·r, r·k) = r·gcd(h₁, k) = r
+    (gcd asserted in tests).  A 127-bit ladder replaces the [r−1] check.
+    """
+    if pt is None:
+        return True
+    nat = _native()
+    if nat is not None:
+        return nat.bls_g1_in_subgroup(g1_to_bytes(pt))
+    return g1_eq(g1_endo(pt), g1_mul(pt, LAMBDA_G1))
+
+
 def g1_from_bytes(data: bytes):
     if data[0] == 0x40:
         return None
@@ -913,9 +944,7 @@ def g1_from_bytes(data: bytes):
     # Subgroup check: on-curve is not enough — cofactor-torsion components
     # survive pairing-based verification (killed by the final exponentiation)
     # but corrupt Lagrange combination of "verified" shares.
-    # [R]·P computed as [R−1]·P + P so the R−1 < r half rides the native
-    # fast path (a 255-bit pure-Python ladder costs ~4 ms per point).
-    if g1_add(g1_mul(pt, R - 1), pt) is not None:
+    if not g1_in_subgroup(pt):
         raise ValueError("G1 point not in the r-order subgroup")
     return pt
 
@@ -942,7 +971,6 @@ def g2_from_bytes(data: bytes):
     pt = ((vals[0], vals[1]), (vals[2], vals[3]), FP2_ONE)
     if not g2_is_on_curve(pt):
         raise ValueError("invalid G2 point")
-    # [R]·P as [R−1]·P + P — native fast path, as in g1_from_bytes
-    if g2_add(g2_mul(pt, R - 1), pt) is not None:
+    if not g2_in_subgroup(pt):
         raise ValueError("G2 point not in the r-order subgroup")
     return pt

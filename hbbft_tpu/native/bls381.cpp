@@ -1819,6 +1819,63 @@ int bls_tpke_mask_batch(const uint8_t* s_be32, const uint8_t* us97, int count,
   return 0;
 }
 
+// Fast subgroup membership via endomorphism eigenvalues (assumes the point
+// is already on the curve — the Python deserializers check that first).
+//
+// Soundness (gcd argument, quantities asserted in tests/test_endomorphism):
+//  G2: ψ(P) = [x]P ⟹ [x²−t·x+p]P = [p−x]P = ∞ (char. eq. of ψ, t = x+1)
+//      and p−x = h₁·r, so ord(P) | gcd(h₁·r, h₂·r) = r·gcd(h₁,h₂) = r.
+//  G1: φ(P) = [λ]P ⟹ [λ²+λ+1]P = [r·k]P = ∞, ord(P) | r·gcd(h₁,k) = r.
+// One small ladder (64/127-bit) replaces the full-width [r−1] check.
+int bls_g1_in_subgroup(const uint8_t* p97) {
+  init_all();
+  G1 p;
+  if (!g1_read(p97, p)) return -1;
+  if (p.inf) return 1;
+  G1 phi, lam;
+  g1_endo(p, phi);
+  u64 l[4] = {BLS_GLV_LAMBDA[0], BLS_GLV_LAMBDA[1], 0, 0};
+  g1_mul_limbs(p, l, 2, lam);
+  // g1_eq via cross-multiplied Jacobians
+  if (phi.inf != lam.inf) return 0;
+  if (phi.inf) return 1;
+  u64 z1z1[6], z2z2[6], a[6], b[6], t[6];
+  FP.sqr(phi.z, z1z1);
+  FP.sqr(lam.z, z2z2);
+  FP.mul(phi.x, z2z2, a);
+  FP.mul(lam.x, z1z1, b);
+  if (Mod<6>::cmp(a, b) != 0) return 0;
+  FP.mul(phi.y, lam.z, t);
+  FP.mul(t, z2z2, a);
+  FP.mul(lam.y, phi.z, t);
+  FP.mul(t, z1z1, b);
+  return Mod<6>::cmp(a, b) == 0 ? 1 : 0;
+}
+
+int bls_g2_in_subgroup(const uint8_t* p193) {
+  init_all();
+  G2 p;
+  if (!g2_read(p193, p)) return -1;
+  if (p.inf) return 1;
+  G2 ps, xp;
+  g2_psi(p, ps);
+  g2_mul_xabs(p, xp);
+  g2_neg_pt(xp, xp);  // [x]P (x < 0)
+  if (ps.inf != xp.inf) return 0;
+  if (ps.inf) return 1;
+  Fp2 z1z1, z2z2, a, b, t;
+  f2_sqr(ps.z, z1z1);
+  f2_sqr(xp.z, z2z2);
+  f2_mul(ps.x, z2z2, a);
+  f2_mul(xp.x, z1z1, b);
+  if (Mod<6>::cmp(a.a, b.a) != 0 || Mod<6>::cmp(a.b, b.b) != 0) return 0;
+  f2_mul(ps.y, xp.z, t);
+  f2_mul(t, z2z2, a);
+  f2_mul(xp.y, ps.z, t);
+  f2_mul(t, z1z1, b);
+  return (Mod<6>::cmp(a.a, b.a) == 0 && Mod<6>::cmp(a.b, b.b) == 0) ? 1 : 0;
+}
+
 // Common-coin batch: out_bits[i] = parity(SHA3(g2_bytes([s]·H_G2(nonce_i))))
 // — the master-scalar god-view fold of ThresholdSign (parallel/aba.py::
 // coin_for), one call for a whole epoch's instance axis.

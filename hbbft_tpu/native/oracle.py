@@ -80,6 +80,8 @@ class NativeOracle:
             ("bls_tpke_encrypt_batch", [u8p, u8p, i64p, i, u8p, u8p], i),
             ("bls_tpke_mask_batch", [u8p, u8p, i, u8p], i),
             ("bls_coin_batch", [u8p, u8p, i64p, i, u8p], i),
+            ("bls_g1_in_subgroup", [u8p], i),
+            ("bls_g2_in_subgroup", [u8p], i),
         ]:
             fn = getattr(lib, name)
             fn.argtypes = args
@@ -329,6 +331,8 @@ class NativeOracle:
     def bls_tpke_mask_batch(self, scalar: int, us) -> list:
         """[scalar]·U for each 97-byte U (the batched decrypt master-scalar
         fold) in one native call."""
+        if not us:
+            return []
         buf = np.concatenate([self._arr(u) for u in us])
         out = self._buf(97 * len(us))
         assert self._lib.bls_tpke_mask_batch(
@@ -337,6 +341,16 @@ class NativeOracle:
         ) == 0
         ob = out.tobytes()
         return [ob[i * 97:(i + 1) * 97] for i in range(len(us))]
+
+    def bls_g1_in_subgroup(self, p: bytes) -> bool:
+        rc = self._lib.bls_g1_in_subgroup(self._p(self._arr(p)))
+        assert rc >= 0
+        return bool(rc)
+
+    def bls_g2_in_subgroup(self, p: bytes) -> bool:
+        rc = self._lib.bls_g2_in_subgroup(self._p(self._arr(p)))
+        assert rc >= 0
+        return bool(rc)
 
     def bls_coin_batch(self, scalar: int, nonces) -> list:
         """parity(SHA3(g2_bytes([scalar]·H_G2(nonce)))) per nonce — a whole

@@ -134,6 +134,91 @@ def make_sharded_rbc_run(rbc: BatchedRbc, mesh):
     return run
 
 
+def make_sharded_rbc_large_run(rbc: BatchedRbc, mesh):
+    """The large-N (N > 256, GF(2^16)) full-delivery RBC round with the
+    PROPOSER axis sharded over ``mesh`` — the round-4 gap that capped the
+    mesh at N ≤ 256.
+
+    The large-N round is a god-view full-delivery verdict: every stage is
+    proposer-parallel with no cross-proposer dataflow, so each device runs
+    :meth:`BatchedRbc.large_stage_a`/``b`` on its slice of proposers and the
+    per-proposer verdict arrays gather back to full size (the all_gather is
+    the Value/Echo fan-out of SURVEY §2.3's comm-backend row — each
+    proposer's shards/root leave its device once).  The straggler decode
+    between the stages stays on the host exactly as in the single-device
+    path; results are bit-equal to :meth:`BatchedRbc._run_large` (tests).
+
+    Returns ``run(data, codeword_tamper=None, value_tamper=None)`` with the
+    ``BatchedRbc.run`` result contract.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    n, f, k = rbc.n, rbc.f, rbc.k
+    axes = tuple(mesh.axis_names)
+    n_dev = mesh.devices.size
+    spec_p = P(axes)
+    spec_r = P()
+
+    fns = {}
+
+    def _stage_fns(P_, has_cw, has_vt):
+        key = (P_, has_cw, has_vt)
+        if key in fns:
+            return fns[key]
+        assert P_ % n_dev == 0, (P_, n_dev)
+        cs = rbc._large_chunk_size(P_ // n_dev)  # chunk per-device slices
+
+        # variable arity: tamper tensors exist as inputs only when given —
+        # no dead (P, N, B) zero buffers on the common honest path
+        def stage_a(d, pbits, *tampers):
+            it = iter(tampers)
+            cw = next(it) if has_cw else None
+            vt = next(it) if has_vt else None
+            return rbc.large_stage_a(d, cw, vt, pbits, cs)
+
+        def stage_b(dr, sent_, vv_, root_, pbits):
+            return rbc.large_stage_b(dr, sent_, vv_, root_, pbits, cs)
+
+        n_tampers = int(has_cw) + int(has_vt)
+        a = jax.jit(shard_map(
+            stage_a, mesh=mesh,
+            in_specs=(spec_p, spec_r) + (spec_p,) * n_tampers,
+            out_specs=(spec_p, spec_p, spec_p, spec_p, spec_p),
+            check_vma=False,
+        ))
+        b = jax.jit(shard_map(
+            stage_b, mesh=mesh,
+            in_specs=(spec_p, spec_p, spec_p, spec_p, spec_r),
+            out_specs=(spec_p, spec_p, spec_p),
+            check_vma=False,
+        ))
+        fns[key] = (a, b)
+        return fns[key]
+
+    def run(data, codeword_tamper=None, value_tamper=None):
+        P_ = data.shape[0]
+        has_cw = codeword_tamper is not None
+        has_vt = value_tamper is not None
+        a, b = _stage_fns(P_, has_cw, has_vt)
+        tampers = tuple(
+            jnp.asarray(t)
+            for t in (codeword_tamper, value_tamper)
+            if t is not None
+        )
+        a_out = a(jnp.asarray(data), rbc._pbits(), *tampers)
+        return rbc.finish_large(
+            a_out,
+            lambda dr, sent_, vv_, root_: b(
+                dr, sent_, vv_, root_, rbc._pbits()
+            ),
+        )
+
+    return run
+
+
 def sharded_rbc_run(rbc: BatchedRbc, mesh, data, **kwargs):
     """One-shot convenience wrapper over :func:`make_sharded_rbc_run`.
 

@@ -355,6 +355,153 @@ class BatchedRbc:
             self._jit_cache[name] = jax.jit(fn)
         return self._jit_cache[name]
 
+    def _large_chunk_size(self, P: int) -> int:
+        # chunk the proposer axis: bounds the keccak working set (P·n Merkle
+        # leaves at once is gigabytes at N=4096).  cs is shape-derived, so
+        # it must be part of the jit-cache key (a cached closure retraced
+        # with a stale cs would mis-reshape a different P).
+        return next(c for c in (64, 32, 16, 8, 4, 2, 1) if P % c == 0)
+
+    @staticmethod
+    def _chunked_map(fn, args, P: int, cs: int):
+        """lax.map ``fn`` over proposer-axis chunks of ``args`` (None
+        members pass through unchunked as empty pytrees)."""
+        import jax
+
+        nch = P // cs
+        chunk = lambda a: (
+            None if a is None else a.reshape(nch, cs, *a.shape[1:])
+        )
+        outs = jax.lax.map(fn, tuple(chunk(a) for a in args))
+        unc = lambda a: a.reshape(a.shape[0] * a.shape[1], *a.shape[2:])
+        return tuple(unc(o) for o in outs)
+
+    def large_stage_a(self, d, cw, vt, pbits, cs: int):
+        """Large-N stage 1 (pure; proposer-parallel): encode + root-only
+        Merkle commit + god-view echo validity.  Shardable over the
+        proposer axis — no cross-proposer dataflow (mesh.py wraps it in
+        ``shard_map``)."""
+        import jax.numpy as jnp
+
+        from hbbft_tpu.ops.merkle import merkle_root_jax
+
+        k = self.k
+
+        def one(args):
+            dc, cwc, vtc = args
+            shards = self.coder.encode_jax(dc, pbits)
+            if cwc is not None:
+                shards = shards ^ cwc
+            root = merkle_root_jax(shards)
+            sent = shards if vtc is None else shards ^ vtc
+            vv = jnp.all(sent == shards, axis=-1)
+            # per-proposer reductions IN-GRAPH so the host decision reads
+            # (P,) scalars instead of the (P, N) vv matrix — 16 MB/epoch
+            # across the bandwidth-limited link at N=4096
+            ec = vv.sum(axis=-1).astype(jnp.int32)
+            ident = vv[..., :k].all(axis=-1)
+            return sent, root, vv, ec, ident
+
+        return self._chunked_map(one, (d, cw, vt), d.shape[0], cs)
+
+    def large_stage_b(self, dr, sent_, vv_, root_, pbits, cs: int):
+        """Large-N stage 2 (pure; proposer-parallel): re-encode, root
+        re-check, framing check.  Shardable like :meth:`large_stage_a`."""
+        import jax.numpy as jnp
+
+        from hbbft_tpu.ops.merkle import merkle_root_jax
+
+        k = self.k
+
+        def one(args):
+            drc, sc, vc, rc = args
+            full = self.coder.encode_jax(drc, pbits)
+            full_obj = jnp.where(vc[..., None], sc, full)
+            root_chk = merkle_root_jax(full_obj)
+            root_ok = jnp.all(root_chk == rc, axis=-1)
+            out_data = full_obj[..., :k, :]
+            B = out_data.shape[-1]
+            flat = out_data.reshape(out_data.shape[0], k * B)
+            ln = (
+                flat[..., 0].astype(jnp.uint32) << 24
+                | flat[..., 1].astype(jnp.uint32) << 16
+                | flat[..., 2].astype(jnp.uint32) << 8
+                | flat[..., 3].astype(jnp.uint32)
+            )
+            frame_ok = ln <= jnp.uint32(k * B - 4)
+            return out_data, root_ok, frame_ok
+
+        return self._chunked_map(one, (dr, sent_, vv_, root_), dr.shape[0], cs)
+
+    def _pbits(self):
+        import jax.numpy as jnp
+
+        if not hasattr(self, "_pbits_dev"):
+            self._pbits_dev = jnp.asarray(self.coder._parity_bits)
+        return self._pbits_dev
+
+    def finish_large(self, stage_a_out, stage_b_fn):
+        """Shared host orchestration of the large-N round: threshold
+        decisions + straggler decode between stage A and stage B, then the
+        ``run`` result-dict assembly.  Used by both the single-device
+        ``_run_large`` and the mesh-sharded variant so the delivery-verdict
+        logic exists exactly once.
+
+        ``stage_a_out``: (sent, root, vv, ec_d, ident_d) device arrays;
+        ``stage_b_fn(data_rec, sent, vv, root)`` runs the (possibly
+        sharded) stage B.
+        """
+        import jax.numpy as jnp
+
+        n, f, k = self.n, self.f, self.k
+        sent, root, vv, ec_d, ident_d = stage_a_out
+        # only the (P,)-shaped reductions cross the link; vv stays on
+        # device for stage B (and is fetched only on the rare straggler
+        # path below)
+        ec = np.asarray(ec_d)
+        ident = np.asarray(ident_d)
+        ready = ec >= (n - f)
+        can_decode = ready & (ec >= k)
+        if bool(ident.all()):
+            data_rec = sent[:, :k, :]
+        else:
+            data_rec = jnp.asarray(self.reconstruct_stragglers(
+                np.asarray(sent), np.asarray(vv), can_decode, ident
+            ))
+
+        out_data, root_ok, frame_ok = stage_b_fn(data_rec, sent, vv, root)
+        root_ok = np.asarray(root_ok)
+        frame_ok = np.asarray(frame_ok)
+        delivered = can_decode & root_ok & frame_ok
+        fault = can_decode & ~(root_ok & frame_ok)
+        P = ec.shape[0]
+        bc = lambda a: np.broadcast_to(a[None, :], (n, P))
+        return {
+            "delivered": bc(delivered),
+            "fault": bc(fault),
+            "data": np.asarray(out_data)[None],  # (1, P, k, B) shared row
+            "data_receivers": np.zeros((1,), dtype=np.int32),
+            "root": np.asarray(root),
+            "echo_count": bc(ec),
+            "ready_count": bc(np.where(ready, n, 0)),
+        }
+
+    def reconstruct_stragglers(self, sent_h, vv_h, can_decode, ident):
+        """Host GF(2^16) reconstruct for proposers whose first k shards did
+        not survive (rare); identity rows elsewhere.  Shared by the
+        single-device and mesh large-N paths."""
+        rows = []
+        k = self.k
+        for p in range(sent_h.shape[0]):
+            if ident[p] or not can_decode[p]:
+                rows.append(sent_h[p, :k])
+                continue
+            use = tuple(np.flatnonzero(vv_h[p])[:k].tolist())
+            rows.append(
+                self.coder.reconstruct_data_np(sent_h[p, list(use)], use)
+            )
+        return np.stack(rows)
+
     def _run_large(self, data, codeword_tamper=None, value_tamper=None):
         """Full-delivery RBC round for N > 256 (GF(2^16) coder).
 
@@ -368,111 +515,28 @@ class BatchedRbc:
            the overwhelmingly common case; host GF(2^16) decode for the
            stragglers), re-encode, root re-check, framing check.
         """
-        import jax
-        import jax.numpy as jnp
-
-        from hbbft_tpu.ops.merkle import merkle_root_jax
-
-        n, f, k = self.n, self.f, self.k
         P = data.shape[0]
-        # chunk the proposer axis: bounds the keccak working set (P·n Merkle
-        # leaves at once is gigabytes at N=4096).  cs is shape-derived, so
-        # it must be part of the jit-cache key (a cached closure retraced
-        # with a stale cs would mis-reshape a different P).
-        cs = next(c for c in (64, 32, 16, 8, 4, 2, 1) if P % c == 0)
-        if not hasattr(self, "_pbits_dev"):
-            self._pbits_dev = jnp.asarray(self.coder._parity_bits)
-
-        def chunked_map(fn, args):
-            """lax.map ``fn`` over proposer-axis chunks of ``args`` (None
-            members pass through unchunked as empty pytrees)."""
-            nch = P // cs
-            chunk = lambda a: (
-                None if a is None else a.reshape(nch, cs, *a.shape[1:])
-            )
-            outs = jax.lax.map(fn, tuple(chunk(a) for a in args))
-            unc = lambda a: a.reshape(a.shape[0] * a.shape[1], *a.shape[2:])
-            return tuple(unc(o) for o in outs)
+        cs = self._large_chunk_size(P)
 
         def stage_a(d, cw, vt, pbits):
-            def one(args):
-                dc, cwc, vtc = args
-                shards = self.coder.encode_jax(dc, pbits)
-                if cwc is not None:
-                    shards = shards ^ cwc
-                root = merkle_root_jax(shards)
-                sent = shards if vtc is None else shards ^ vtc
-                vv = jnp.all(sent == shards, axis=-1)
-                return shards, sent, root, vv
-
-            return chunked_map(one, (d, cw, vt))
+            return self.large_stage_a(d, cw, vt, pbits, cs)
 
         key = ("A", P, cs, codeword_tamper is not None,
                value_tamper is not None)
-        shards, sent, root, vv = self._jit(key, stage_a)(
-            data, codeword_tamper, value_tamper, self._pbits_dev
+        a_out = self._jit(key, stage_a)(
+            data, codeword_tamper, value_tamper, self._pbits()
         )
-        vv_h = np.asarray(vv)
-        ec = vv_h.sum(axis=1)  # (P,)
-        ready = ec >= (n - f)
-        can_decode = ready & (ec >= k)
-
-        # decode: identity where the first k shards are intact; host GF(2^16)
-        # reconstruct otherwise
-        ident = vv_h[:, :k].all(axis=1)
-        if bool(ident.all()):
-            data_rec = sent[:, :k, :]
-        else:
-            sent_h = np.asarray(sent)
-            rows = []
-            for p in range(P):
-                if ident[p] or not can_decode[p]:
-                    rows.append(sent_h[p, :k])
-                    continue
-                use = tuple(np.flatnonzero(vv_h[p])[:k].tolist())
-                rows.append(
-                    self.coder.reconstruct_data_np(sent_h[p, list(use)], use)
-                )
-            data_rec = jnp.asarray(np.stack(rows))
 
         def stage_b(dr, sent_, vv_, root_, pbits):
-            def one(args):
-                drc, sc, vc, rc = args
-                full = self.coder.encode_jax(drc, pbits)
-                full_obj = jnp.where(vc[..., None], sc, full)
-                root_chk = merkle_root_jax(full_obj)
-                root_ok = jnp.all(root_chk == rc, axis=-1)
-                out_data = full_obj[..., :k, :]
-                B = out_data.shape[-1]
-                flat = out_data.reshape(out_data.shape[0], k * B)
-                ln = (
-                    flat[..., 0].astype(jnp.uint32) << 24
-                    | flat[..., 1].astype(jnp.uint32) << 16
-                    | flat[..., 2].astype(jnp.uint32) << 8
-                    | flat[..., 3].astype(jnp.uint32)
-                )
-                frame_ok = ln <= jnp.uint32(k * B - 4)
-                return out_data, root_ok, frame_ok
+            return self.large_stage_b(dr, sent_, vv_, root_, pbits, cs)
 
-            return chunked_map(one, (dr, sent_, vv_, root_))
-
-        out_data, root_ok, frame_ok = self._jit(("B", P, cs), stage_b)(
-            data_rec, sent, vv, root, self._pbits_dev
+        jit_b = self._jit(("B", P, cs), stage_b)
+        return self.finish_large(
+            a_out,
+            lambda dr, sent_, vv_, root_: jit_b(
+                dr, sent_, vv_, root_, self._pbits()
+            ),
         )
-        root_ok = np.asarray(root_ok)
-        frame_ok = np.asarray(frame_ok)
-        delivered = can_decode & root_ok & frame_ok
-        fault = can_decode & ~(root_ok & frame_ok)
-        bc = lambda a: np.broadcast_to(a[None, :], (n, P))
-        return {
-            "delivered": bc(delivered),
-            "fault": bc(fault),
-            "data": np.asarray(out_data)[None],  # (1, P, k, B) shared row
-            "data_receivers": np.zeros((1,), dtype=np.int32),
-            "root": np.asarray(root),
-            "echo_count": bc(ec),
-            "ready_count": bc(np.where(ready, n, 0)),
-        }
 
 
 # -- host-side helpers for tests / object-mode cross-checks -----------------

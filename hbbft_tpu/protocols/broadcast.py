@@ -238,8 +238,13 @@ class Broadcast(ConsensusProtocol):
 
     def _handle_can_decode(self, sender_id: NodeId, root: bytes) -> Step:
         roots = self.can_decodes.setdefault(sender_id, set())
-        if root in roots:  # a repeat for the SAME root is the fault;
-            # distinct roots are legitimate under proposer equivocation
+        # Honest bound: CanDecode(root) requires ≥ k = N−2f full echoes for
+        # that root, each sender's echo binds to ONE root (MultipleEchos is
+        # a fault), and k ≥ (N+2)/3, so at most ⌊N/k⌋ ≤ 2 distinct roots
+        # can ever cross the threshold at one node.  A repeat for the same
+        # root, or a third root, is therefore provably faulty — and the
+        # bound keeps per-sender state O(1) against root-spamming peers.
+        if root in roots or len(roots) >= 2:
             return Step.from_fault(sender_id, FaultKind.MultipleCanDecodes)
         roots.add(root)
         return Step()

@@ -151,3 +151,62 @@ def test_hash_outputs_have_order_r(oracle):
         with H.pure_python():
             assert H.g1_add(H.g1_mul(g1, H.R - 1), g1) is None
             assert H.g2_add(H.g2_mul(g2, H.R - 1, mod_r=False), g2) is None
+
+
+def test_subgroup_check_soundness_gcds():
+    # the gcd facts the eigenvalue subgroup tests rest on (see
+    # bls12_381.g1_in_subgroup / g2_in_subgroup docstrings)
+    import math
+
+    lam = H.LAMBDA_G1
+    k = (lam * lam + lam + 1) // H.R
+    assert (lam * lam + lam + 1) % H.R == 0
+    assert math.gcd(H.H1, k) == 1
+    assert H.P - H.X == H.H1 * H.R  # p − x = h₁·r (char. eq. route)
+    assert math.gcd(H.H1, H.H2) == 1
+    assert H.H1 % H.R != 0 and H.H2 % H.R != 0
+
+
+def test_subgroup_checks_accept_and_reject(oracle):
+    rng = random.Random(21)
+    # members accepted (native + pure python agree)
+    for _ in range(2):
+        k = rng.randrange(1, H.R)
+        p1 = H.g1_mul(H.G1_GEN, k)
+        p2 = H.g2_mul(H.G2_GEN, k)
+        assert oracle.bls_g1_in_subgroup(H.g1_to_bytes(p1))
+        assert oracle.bls_g2_in_subgroup(H.g2_to_bytes(p2))
+        with H.pure_python():
+            assert H.g1_in_subgroup(p1)
+            assert H.g2_in_subgroup(p2)
+    # a pre-clearing twist point has cofactor torsion → rejected
+    raw2 = _raw_twist_point(b"not-in-g2")
+    with H.pure_python():
+        raw2a = H.g2_affine(raw2)
+        assert not H.g2_in_subgroup(raw2a)
+        assert H.g2_is_on_curve(raw2a)  # on-curve but outside G2
+    assert not oracle.bls_g2_in_subgroup(H.g2_to_bytes(raw2))
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="subgroup"):
+        H.g2_from_bytes(H.g2_to_bytes(raw2))
+    # same for G1: raw hash candidate before clearing
+    import hashlib
+
+    ctr = 0
+    while True:
+        h0 = hashlib.sha3_256(b"H1G-raw0" + ctr.to_bytes(4, "big")).digest()
+        x = int.from_bytes(h0, "big") % H.P
+        rhs = (x * x % H.P * x + H.B1) % H.P
+        y = H.fp_sqrt(rhs)
+        if y:
+            raw1 = (x, y, 1)
+            break
+        ctr += 1
+    # raw1 is on E(Fp) but (w.h.p.) not in the r-order subgroup
+    with H.pure_python():
+        in_g1 = H.g1_in_subgroup(raw1)
+    assert oracle.bls_g1_in_subgroup(H.g1_to_bytes(raw1)) == in_g1
+    if not in_g1:
+        with _pytest.raises(ValueError, match="subgroup"):
+            H.g1_from_bytes(H.g1_to_bytes(raw1))
