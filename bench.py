@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -501,27 +502,60 @@ def _bench_hb_epoch_large(n: int, tx_bytes: int, iters: int, tag: str):
         assert batch == contribs
     t_dev = float(np.median(times))
 
-    # object-mode baseline measured at N=16, scaled by the ~N³ message count
-    small = 16
-    s_infos = NetworkInfo.generate_map(list(range(small)), random.Random(5))
-    s_contribs = {i: contribs[i] for i in range(small)}
-    net = NetBuilder(list(range(small))).adversary(NullAdversary()).using_step(
-        lambda nid: HoneyBadger.builder(s_infos[nid])
-        .session_id(tag.encode())
-        .encryption_schedule(EncryptionSchedule.always())
-        .rng(random.Random(200 + nid))
-        .build()
-    )
-    t0 = time.perf_counter()
-    for nid in net.node_ids():
-        net.send_input(nid, s_contribs[nid])
-    net.run_to_quiescence()
-    t_small = time.perf_counter() - t0
-    for nid in net.node_ids():
-        assert any(isinstance(o, Batch) for o in net.nodes[nid].outputs)
-    per_msg = t_small / max(net.messages_delivered, 1)
-    est_msgs = net.messages_delivered * (n / small) ** 3
-    t_host_est = per_msg * est_msgs
+    # Host baseline.  N=64 has a MEASURED object-mode epoch on record
+    # (tools_measure_host64.py → BASELINE_MEASURED.json — one full
+    # 904.6 s / 1.98M-message run; no extrapolation).  Other N scale from
+    # the measured run by the ~N³ message count (flagged `extrapolated`).
+    measured = _measured_baseline(n)
+    if measured is not None:
+        t_host, host_note = measured
+        return {
+            "metric": f"hb_epoch{n}_batched",
+            "value": round(1.0 / t_dev, 3),
+            "unit": "epochs/s",
+            "vs_baseline": round(t_host / t_dev, 1),
+            "t_device_s": round(t_dev, 4),
+            "t_host_measured_s": round(t_host, 1),
+            "host_note": host_note,
+            "shape": f"N={n} f={(n - 1) // 3} tx={tx_bytes}B",
+        }
+
+    base = _measured_baseline(64)
+    if base is not None:
+        # scale the MEASURED N=64 run by message count (~N³) — still an
+        # extrapolation for this n, but anchored to a real 1.98M-message
+        # measurement instead of the N=16 toy run
+        t64, note64 = base
+        t_host_est = t64 * (n / 64) ** 3
+        host_note = (f"~N^3-scaled from the measured N=64 host epoch "
+                     f"({note64})")
+    else:
+        # fallback: measure N=16 object mode live and scale (~N³ messages)
+        small = 16
+        s_infos = NetworkInfo.generate_map(
+            list(range(small)), random.Random(5)
+        )
+        s_contribs = {i: contribs[i] for i in range(small)}
+        net = NetBuilder(list(range(small))).adversary(
+            NullAdversary()
+        ).using_step(
+            lambda nid: HoneyBadger.builder(s_infos[nid])
+            .session_id(tag.encode())
+            .encryption_schedule(EncryptionSchedule.always())
+            .rng(random.Random(200 + nid))
+            .build()
+        )
+        t0 = time.perf_counter()
+        for nid in net.node_ids():
+            net.send_input(nid, s_contribs[nid])
+        net.run_to_quiescence()
+        t_small = time.perf_counter() - t0
+        for nid in net.node_ids():
+            assert any(isinstance(o, Batch) for o in net.nodes[nid].outputs)
+        per_msg = t_small / max(net.messages_delivered, 1)
+        t_host_est = per_msg * net.messages_delivered * (n / small) ** 3
+        host_note = (f"extrapolated from N={small} object-mode "
+                     f"({net.messages_delivered} msgs in {t_small:.2f}s)")
 
     return {
         "metric": f"hb_epoch{n}_batched",
@@ -530,11 +564,30 @@ def _bench_hb_epoch_large(n: int, tx_bytes: int, iters: int, tag: str):
         "vs_baseline": round(t_host_est / t_dev, 1),
         "t_device_s": round(t_dev, 4),
         "t_host_est_s": round(t_host_est, 1),
-        "host_note": f"extrapolated from N={small} object-mode "
-                     f"({net.messages_delivered} msgs in {t_small:.2f}s)",
+        "host_note": host_note,
         "extrapolated": True,
         "shape": f"N={n} f={(n - 1) // 3} tx={tx_bytes}B",
     }
+
+
+def _measured_baseline(n: int):
+    """(t_epoch_s, note) from BASELINE_MEASURED.json for this N, if a
+    measured (non-extrapolated) object-mode run is on record."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE_MEASURED.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+        rec = data[f"hb_epoch{n}_host"]
+        note = (f"MEASURED object-mode epoch: {rec['t_epoch_s']}s, "
+                f"{rec['messages_delivered']} msgs ({rec['measured_utc']}; "
+                f"{rec['notes']})")
+        return float(rec["t_epoch_s"]), note
+    except (KeyError, TypeError, ValueError, OSError):
+        # absent/partial/hand-edited record → the extrapolation fallback
+        return None
 
 
 def bench_hb_epoch64():

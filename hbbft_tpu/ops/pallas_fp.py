@@ -1,0 +1,122 @@
+"""Pallas (Mosaic) kernel for the lazy 13-bit×30-limb field — an EXPERIMENT.
+
+Round-3/4 verdicts asked whether a Pallas kernel that keeps ladder limbs
+resident in VMEM could beat the XLA lowering of :mod:`hbbft_tpu.ops.fp381`
+in the compute-bound MSM regime (the dkg 16 384-row ladder, SURVEY §7.2a).
+This module is the measured answer.  It implements the SAME lazy-field
+multiplication (schoolbook limb convolution → rough carries → fold-by-rows
+→ squeeze) as a Pallas TPU kernel in the lanes-last ``(NL, R)`` layout and
+is bit-exact against ``fp381`` (tests, interpret mode on CPU; verified on
+the real chip too).
+
+Measured on TPU v5 lite (2026-07-31, tunneled chip, in-kernel 50-mul chain
+so launch/transfer amortize):
+
+  ===========  ==================  =========================
+  rows R       Pallas (this file)  XLA lowering of fp381
+  ===========  ==================  =========================
+  8192         522 ns/row-mul      ~135 ns/row-mul
+  2048         1382 ns/row-mul     (launch-bound regime)
+  ===========  ==================  =========================
+
+i.e. Mosaic currently lowers the pad-shifted-FMA convolution ~4× SLOWER
+than XLA's fusion of the identical math — each ``jnp.pad`` materializes a
+(61, R) buffer, and the 30 pads per product dominate VMEM traffic.  The
+roofline conclusion (recorded in STATUS.md): this op is MEMORY-bound
+elementwise int32 with arithmetic intensity ≈ 0.5 op/byte — both lowerings
+run at ~1 % of VPU peak, so the ceiling is bandwidth/fusion, not the
+int32 ALU, and a winning kernel would need a fundamentally different data
+layout (limbs in registers across ladder steps), which Mosaic does not
+express today.  The compute-bound MSM crown therefore stays with the
+ADX/BMI2 host oracle (~40 ns/mul after round 5); the device ladder wins in
+the launch-bound small-batch regime (MXU field) and by row-sharding over a
+mesh (``crypto/batch.use_mesh``).
+
+Kept as a working, tested kernel so the next attempt starts from running
+code rather than a blank file.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from hbbft_tpu.ops import fp381 as F
+
+NL = F.NL
+MASK = F.MASK
+LIMB_BITS = F.LIMB_BITS
+
+_FOLD_HI = np.asarray(F._FOLD_HI, np.int32)  # (31, 30) residue rows
+
+
+def _shift1(c):
+    """Digits up one position along the LIMB axis (axis 0)."""
+    import jax.numpy as jnp
+
+    return jnp.pad(c[:-1], ((1, 0), (0, 0)))
+
+
+def _carry_rough(t):
+    for _ in range(3):
+        t = (t & MASK) + _shift1(t >> LIMB_BITS)
+    return t
+
+
+def _conv(a, b):
+    """Schoolbook convolution over (2·NL+1, R) via pad-shifted FMAs."""
+    import jax.numpy as jnp
+
+    t = jnp.pad(a[0] * b, ((0, NL + 1), (0, 0)))
+    for i in range(1, NL):
+        t = t + jnp.pad(a[i] * b, ((i, NL + 1 - i), (0, 0)))
+    return t
+
+
+def _fold_hi(t, fold):
+    acc = t[:NL]
+    for j in range(NL + 1):
+        acc = acc + t[NL + j] * fold[j][:, None]
+    return acc
+
+
+def _squeeze(acc, row0):
+    import jax.numpy as jnp
+
+    acc = _carry_rough(jnp.pad(acc, ((0, 1), (0, 0))))
+    for _ in range(4):
+        top = acc[NL]
+        acc = _carry_rough(
+            jnp.pad(acc[:NL] + top * row0[:, None], ((0, 1), (0, 0)))
+        )
+    return acc[:NL]
+
+
+def mul_lazy_cols(a, b, fold):
+    """Lazy modular product, ``(NL, R)`` columns layout (limb axis first).
+
+    Same semantics as ``fp381.fp_mul_lazy`` on the transposed layout."""
+    return _squeeze(_fold_hi(_carry_rough(_conv(a, b)), fold), fold[0])
+
+
+def _mul_kernel(a_ref, b_ref, fold_ref, o_ref):
+    o_ref[:] = mul_lazy_cols(a_ref[:], b_ref[:], fold_ref[:])
+
+
+def fp_mul_lazy_pallas(a, b, interpret: bool = False):
+    """One lazy field multiplication as a Pallas kernel.
+
+    ``a``, ``b``: int32 ``(NL, R)`` lazy-digit columns; returns the same.
+    ``interpret=True`` runs the Pallas interpreter (CPU tests).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.pallas_call(
+        _mul_kernel,
+        out_shape=jax.ShapeDtypeStruct(a.shape, jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(a, b, jnp.asarray(_FOLD_HI))

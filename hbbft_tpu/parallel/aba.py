@@ -21,10 +21,19 @@ adversarial drops as masks):
   epochs through their recorded Terms, exactly like object-mode
   ``_next_epoch``.
 
-Documented bulk-sync divergence from object mode: when both values enter
-``bin_values`` in the same sub-round, the object implementation's Aux choice
-depends on message arrival order; here it deterministically prefers True.
-Either choice is protocol-valid (agreement/validity/termination hold).
+Aux-choice semantics (round 5): object mode sends Aux for the value whose
+2f+1-th BVal arrives FIRST.  The bulk-sync step models the round
+structure exactly: with ``o_i(v)`` = the round node i first sends BVal(v)
+(−1 for the initial estimate; relays loop back to the sender INSTANTLY,
+as ``_broadcast_sbv`` does), node j's observed count in round t is
+``c_j(t) = |{i≠j : o_i < t}| + [o_j ≤ t]`` — everyone else's sends arrive
+one round later, its own immediately.  Relay fires at f+1 within the
+round, crossing (bin_values entry) at 2f+1; the Aux choice is the value
+with the earlier PER-NODE crossing round, same-round tie → True.  Under
+round-aligned delivery with True-before-False tie order — the schedule
+class ``tests/test_aba_cross_mode.py`` pins down — the two modes agree
+verdict-for-verdict; under arbitrary masks any first-crossing choice is
+protocol-valid (agreement/validity/termination hold; invariant suite).
 """
 
 from __future__ import annotations
@@ -34,6 +43,50 @@ import struct
 from typing import Dict, Optional
 
 import numpy as np
+
+
+def sbv_round_model(sent, f: int, n_rounds: int, count_fn, inf):
+    """The per-node BVal round model (module doc), shared by every step
+    variant (masked/full × single-device/mesh — bit-equality across them is
+    test-pinned, so the model lives exactly once).
+
+    ``sent``: bool (..., 2) initial senders; ``count_fn(early) -> E`` is the
+    caller's neighbor reduction (masked einsum / global sum / psum /
+    gather+einsum), returning each node's view of |{i : o_i < t}| INCLUDING
+    its own row — roundstep subtracts the own-row indicator and adds the
+    instant-self term ``[o_j ≤ t]``.  Returns ``(o, x)``: first-send and
+    per-node crossing rounds (``inf`` = never).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    o0 = jnp.where(sent, jnp.int32(-1), inf)
+    x0 = jnp.full_like(o0, inf)
+
+    def roundstep(t, carry):
+        o, x = carry
+        t = t.astype(jnp.int32)
+        early = (o < t).astype(jnp.int32)
+        E = count_fn(early)
+        c0 = E - early + (o <= t)
+        o = jnp.where((c0 >= (f + 1)) & (o == inf), t, o)
+        # a round-t relay changes only [o_j ≤ t] (o=t is not < t), so E
+        # and early are unchanged
+        c1 = E - early + (o <= t)
+        x = jnp.where((c1 >= (2 * f + 1)) & (x == inf), t, x)
+        return o, x
+
+    return jax.lax.fori_loop(0, n_rounds, roundstep, (o0, x0))
+
+
+def aux_pref_from_crossings(x, inf):
+    """(bin_vals_per_node, pref_true) from crossing rounds: the earlier-
+    crossing value wins the Aux choice, same-round tie → True."""
+    binv = x < inf
+    pref_true = binv[..., 1] & (
+        ~binv[..., 0] | (x[..., 1] <= x[..., 0])
+    )
+    return binv, pref_true
 
 
 class BatchedAba:
@@ -101,28 +154,23 @@ class BatchedAba:
         term_axis = jnp.stack([~decision, decision], axis=-1)
         sent = jnp.where(decided[..., None], term_axis, val_axis)
 
-        # f+1 relay / 2f+1 bin_values to fixpoint — monotone, but relay
-        # chains can be up to ~n hops long under partial delivery masks
-        # (same reason rbc.py iterates its Ready amplification n times)
-        import jax
-
-        def relay(_, s):
-            cnt = jnp.einsum(
-                "ipv,ijp->jpv", s.astype(jnp.int32),
-                bval_mask.astype(jnp.int32),
-            )
-            return s | (cnt >= (f + 1))
-
-        sent = jax.lax.fori_loop(0, n, relay, sent)
-        cnt = jnp.einsum(
-            "ipv,ijp->jpv", sent.astype(jnp.int32),
-            bval_mask.astype(jnp.int32),
+        # masked round model: counts c_j(t) = Σ_{i≠j} mask[i,j]·[o_i<t] +
+        # [o_j ≤ t] (own sends loop back instantly); relay chains can be up
+        # to ~n hops long under partial delivery masks (same reason rbc.py
+        # iterates its Ready amplification n times)
+        INF = jnp.int32(n + 4)
+        maski = bval_mask.astype(jnp.int32)
+        o, x = sbv_round_model(
+            sent, f, n + 2,
+            lambda early: jnp.einsum("ipv,ijp->jpv", early, maski),
+            INF,
         )
-        bin_vals = cnt >= (2 * f + 1)  # (N, P, 2) per receiver
+        bin_vals, pref_true = aux_pref_from_crossings(x, INF)  # (N, P, 2)
 
-        # -- Aux: first bin_value (True-preference); deciders send Term val
+        # -- Aux: earlier-crossing bin_value (tie → True); deciders send
+        # their Term value
         has_any = bin_vals.any(axis=-1)
-        aux_val = jnp.where(decided, decision, bin_vals[..., 1])  # True pref
+        aux_val = jnp.where(decided, decision, pref_true)
         aux_sent = has_any | decided
         # support at receiver j: senders i whose aux value ∈ bin_vals[j]
         aux_v = jnp.stack([~aux_val, aux_val], axis=-1) & aux_sent[..., None]
@@ -176,7 +224,20 @@ class BatchedAba:
         vals_single = only_true | only_false
         vals_val = only_true  # the singleton's value (valid when single)
         ready = conf_done & sbv_done & active
-        decide_now = ready & vals_single & (vals_val == coin_b)
+        # Decision guard for the LOSSY lockstep model: MMR's safety rests
+        # on every correct node completing every epoch (true in the async
+        # model with reliable channels — a node waits inside the epoch
+        # until its thresholds are met).  The lockstep step instead lets a
+        # mask-starved node SKIP the epoch with est unchanged, so a lone
+        # decider could strand against nodes that never saw its quorum.
+        # Gating decisions on all-active-nodes-completed restores safety
+        # (a documented god-view over-approximation; full-delivery and
+        # round-aligned schedules are unaffected — there the predicate is
+        # implied).  Termination still follows once delivery recovers.
+        all_complete = ((conf_done & sbv_done) | ~active).all(axis=0)  # (P,)
+        decide_now = (
+            ready & vals_single & (vals_val == coin_b) & all_complete[None]
+        )
         new_est = jnp.where(
             vals_single, vals_val, coin_b
         )  # singleton carries; BOTH adopts coin
@@ -214,18 +275,15 @@ class BatchedAba:
         term_axis = jnp.stack([~decision, decision], axis=-1)
         sent = jnp.where(decided[..., None], term_axis, val_axis)  # (N,P,2)
 
-        def relay(_, s):
-            cnt = s.sum(axis=0)  # (P, 2) — identical at every receiver
-            return s | (cnt >= (f + 1))[None]
-
-        # with full delivery one relay round reaches the fixpoint (every
-        # f+1-supported value is re-broadcast by everyone at once); a second
-        # covers the cascade where the relay itself creates new f+1 support
-        sent = jax.lax.fori_loop(0, 2, relay, sent)
-        cnt = sent.sum(axis=0)
-        bin_vals = cnt >= (2 * f + 1)  # (P, 2), shared
-
-        aux_val = jnp.where(decided, decision, bin_vals[None, :, 1])
+        # full-delivery round model: the neighbor count is one global sum;
+        # the fixpoint is reached in ≤ 2 spread rounds, 4 covers margins
+        INF = jnp.int32(9)
+        o, x = sbv_round_model(
+            sent, f, 4, lambda early: early.sum(axis=0)[None], INF
+        )
+        binv_j, pref_true = aux_pref_from_crossings(x, INF)  # (N, P, 2)
+        bin_vals = binv_j.any(axis=0)  # (P, 2) — same set at fixpoint
+        aux_val = jnp.where(decided, decision, pref_true)
         aux_sent = bin_vals.any(axis=-1)[None] | decided
         aux_v = jnp.stack([~aux_val, aux_val], axis=-1) & aux_sent[..., None]
         support = (aux_v & bin_vals[None]).any(axis=-1).sum(axis=0)  # (P,)

@@ -273,15 +273,22 @@ def make_sharded_aba_step(aba, mesh):
         term_axis = jnp.stack([~decision, decision], axis=-1)
         sent = jnp.where(decided[..., None], term_axis, val_axis)
 
-        def relay(_, s):
-            cnt = _psum(s.sum(axis=0))  # (P, 2) — identical everywhere
-            return s | (cnt >= (f + 1))[None]
+        # full-delivery round model (parallel/aba.py::sbv_round_model) with
+        # the node rows sharded: the neighbor count is a psum, everything
+        # else stays local — bit-equal to the single-device step (tests)
+        from hbbft_tpu.parallel.aba import (
+            aux_pref_from_crossings,
+            sbv_round_model,
+        )
 
-        sent = jax.lax.fori_loop(0, 2, relay, sent)
-        cnt = _psum(sent.sum(axis=0))
-        bin_vals = cnt >= (2 * f + 1)  # (P, 2), shared
-
-        aux_val = jnp.where(decided, decision, bin_vals[None, :, 1])
+        INF = jnp.int32(9)
+        o, x = sbv_round_model(
+            sent, f, 4,
+            lambda early: _psum(early.sum(axis=0))[None], INF,
+        )
+        binv_j, pref_true = aux_pref_from_crossings(x, INF)  # (per, P, 2)
+        bin_vals = _psum(binv_j.any(axis=0).astype(jnp.int32)) > 0  # (P, 2)
+        aux_val = jnp.where(decided, decision, pref_true)
         aux_sent = bin_vals.any(axis=-1)[None] | decided
         aux_v = jnp.stack([~aux_val, aux_val], axis=-1) & aux_sent[..., None]
         support = _psum((aux_v & bin_vals[None]).any(axis=-1).sum(axis=0))
@@ -342,23 +349,25 @@ def make_sharded_aba_step(aba, mesh):
         term_axis = jnp.stack([~decision, decision], axis=-1)
         sent = jnp.where(decided[..., None], term_axis, val_axis)  # local
 
-        def relay(_, s):
-            s_full = _gather_nodes(s, axes)  # (N, P, 2)
-            cnt = jnp.einsum(
-                "ipv,ijp->jpv", s_full.astype(jnp.int32),
-                bm.astype(jnp.int32),
-            )  # (per, P, 2) — my receivers
-            return s | (cnt >= (f + 1))
-
-        sent = jax.lax.fori_loop(0, n, relay, sent)
-        sent_full = _gather_nodes(sent, axes)
-        cnt = jnp.einsum(
-            "ipv,ijp->jpv", sent_full.astype(jnp.int32),
-            bm.astype(jnp.int32),
+        # masked round model (parallel/aba.py::sbv_round_model): o/x rows
+        # local, the neighbor sum gathers the o<t indicators — bit-equal to
+        # BatchedAba.epoch_step (tests)
+        from hbbft_tpu.parallel.aba import (
+            aux_pref_from_crossings,
+            sbv_round_model,
         )
-        bin_vals = cnt >= (2 * f + 1)  # (per, P, 2)
 
-        aux_val = jnp.where(decided, decision, bin_vals[..., 1])
+        INF = jnp.int32(n + 4)
+        bmi = bm.astype(jnp.int32)
+        o, x = sbv_round_model(
+            sent, f, n + 2,
+            lambda early: jnp.einsum(
+                "ipv,ijp->jpv", _gather_nodes(early, axes), bmi
+            ),
+            INF,
+        )
+        bin_vals, pref_true = aux_pref_from_crossings(x, INF)  # (per, P, 2)
+        aux_val = jnp.where(decided, decision, pref_true)
         aux_sent = bin_vals.any(axis=-1) | decided
         aux_v = jnp.stack([~aux_val, aux_val], axis=-1) & aux_sent[..., None]
         aux_v_full = _gather_nodes(aux_v, axes)  # (N, P, 2)
@@ -400,7 +409,15 @@ def make_sharded_aba_step(aba, mesh):
         vals_single = only_true | (vals[..., 0] & ~vals[..., 1])
         vals_val = only_true
         ready = conf_done & sbv_done & active
-        decide_now = ready & vals_single & (vals_val == coin_b)
+        # all-active-completed decision guard (see parallel/aba.py — the
+        # lossy-lockstep safety condition), psum'd across the node shards
+        incomplete = _psum(
+            (~((conf_done & sbv_done) | ~active)).sum(axis=0)
+        )  # (P,)
+        decide_now = (
+            ready & vals_single & (vals_val == coin_b)
+            & (incomplete == 0)[None]
+        )
         new_est = jnp.where(vals_single, vals_val, coin_b)
         est = jnp.where(ready, new_est, est)
         decision = jnp.where(decide_now, coin_b, decision)

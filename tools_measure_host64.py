@@ -1,0 +1,68 @@
+"""Measure the OBJECT-MODE (host) full-TPKE HoneyBadger epoch at N=64 f=21.
+
+One-shot evidence run for the round-5 verdict ask: replace the N^3
+extrapolation behind `hb_epoch64`'s vs_baseline with a measurement.  The
+result is recorded in BASELINE_MEASURED.json (committed) and bench.py reads
+it for the measured baseline row.  Run it on an otherwise idle box:
+
+    python tools_measure_host64.py
+"""
+import json, os, random, sys, time
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from hbbft_tpu.netinfo import NetworkInfo
+from hbbft_tpu.protocols.honey_badger import Batch, EncryptionSchedule, HoneyBadger
+from hbbft_tpu.sim import NetBuilder, NullAdversary
+
+N, F, TX = 64, 21, 256
+
+t0 = time.perf_counter()
+infos = NetworkInfo.generate_map(list(range(N)), random.Random(5))
+t_keys = time.perf_counter() - t0
+print(f"# keygen: {t_keys:.1f}s", file=sys.stderr, flush=True)
+
+rng = random.Random(23)
+contribs = {
+    i: bytes(rng.randrange(256) for _ in range(TX)) for i in range(N)
+}
+net = NetBuilder(list(range(N))).adversary(NullAdversary()).message_limit(
+    100_000_000
+).crank_limit(100_000_000).using_step(
+    lambda nid: HoneyBadger.builder(infos[nid])
+    .session_id(b"hb-epoch64-host")
+    .encryption_schedule(EncryptionSchedule.always())
+    .rng(random.Random(200 + nid))
+    .build()
+)
+t0 = time.perf_counter()
+for nid in net.node_ids():
+    net.send_input(nid, contribs[nid])
+net.run_to_quiescence()
+t_epoch = time.perf_counter() - t0
+for nid in net.node_ids():
+    assert any(isinstance(o, Batch) for o in net.nodes[nid].outputs), nid
+print(f"# epoch: {t_epoch:.1f}s, {net.messages_delivered} msgs",
+      file=sys.stderr, flush=True)
+
+out = {
+    "metric": "hb_epoch64_host_measured",
+    "t_epoch_s": round(t_epoch, 1),
+    "messages_delivered": net.messages_delivered,
+    "shape": f"N={N} f={F} tx={TX}B",
+    "notes": "object-mode VirtualNet, NullAdversary, full TPKE, "
+             "endomorphism-accelerated native oracle (round 5); "
+             "single CPU core",
+    "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+}
+path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "BASELINE_MEASURED.json")
+data = {}
+if os.path.exists(path):
+    data = json.load(open(path))
+data["hb_epoch64_host"] = out
+json.dump(data, open(path, "w"), indent=1)
+print(json.dumps(out))
