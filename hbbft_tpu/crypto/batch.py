@@ -94,10 +94,19 @@ class _MsmCache:
         # checks are sound (see ops/fp381.py); host fold canonicalizes.
         # I/O is ONE stacked array each way: per-coordinate transfers cost a
         # full tunnel round-trip each (~100 ms) on the remote-chip setup.
+        import os
+
         rep, fp_ops, fp2_ops = _field_rep(size)
-        # the resolved backend is part of the key: flipping
-        # HBBFT_FIELD_BACKEND mid-process must not serve a stale ladder
-        key = (group, size, rep.__name__)
+        # HBBFT_PLAIN_LADDER=1 forces the plain bitwise ladder: its XLA
+        # graph compiles ~8× faster than the windowed one (30 s vs 250 s
+        # cold for g2@8 on the CPU backend) at a ~1.5× runtime cost — the
+        # test suite sets it (tests/conftest.py) so cold-cache suite runs
+        # are not dominated by ladder compiles; production (TPU bench)
+        # keeps the windowed default.  Both are exact.
+        plain = os.environ.get("HBBFT_PLAIN_LADDER") == "1"
+        # the resolved backend/ladder style is part of the key: flipping
+        # the env vars mid-process must not serve a stale ladder
+        key = (group, size, rep.__name__, plain)
         if key not in self._fns:
             import jax
             import jax.numpy as jnp
@@ -106,7 +115,7 @@ class _MsmCache:
             # they save, so the plain bitwise ladder is faster there
             lad = (
                 G.scalar_mul_lazy_window
-                if size <= MXU_MAX_BATCH
+                if size <= MXU_MAX_BATCH and not plain
                 else G.scalar_mul_lazy
             )
 

@@ -45,6 +45,22 @@ from typing import Dict, Optional
 import numpy as np
 
 
+# Round-model horizons, shared by the single-device and mesh step variants
+# (their bit-equality is test-pinned — the constants must match pairwise).
+# Full delivery reaches the fixpoint in ≤ 2 spread rounds (4 = margin);
+# masked relay chains can be ~n hops.
+SBV_ROUNDS_FULL = 4
+SBV_INF_FULL = 9
+
+
+def sbv_rounds_masked(n: int) -> int:
+    return n + 2
+
+
+def sbv_inf_masked(n: int) -> int:
+    return n + 4
+
+
 def sbv_round_model(sent, f: int, n_rounds: int, count_fn, inf):
     """The per-node BVal round model (module doc), shared by every step
     variant (masked/full × single-device/mesh — bit-equality across them is
@@ -158,10 +174,10 @@ class BatchedAba:
         # [o_j ≤ t] (own sends loop back instantly); relay chains can be up
         # to ~n hops long under partial delivery masks (same reason rbc.py
         # iterates its Ready amplification n times)
-        INF = jnp.int32(n + 4)
+        INF = jnp.int32(sbv_inf_masked(n))
         maski = bval_mask.astype(jnp.int32)
         o, x = sbv_round_model(
-            sent, f, n + 2,
+            sent, f, sbv_rounds_masked(n),
             lambda early: jnp.einsum("ipv,ijp->jpv", early, maski),
             INF,
         )
@@ -275,11 +291,11 @@ class BatchedAba:
         term_axis = jnp.stack([~decision, decision], axis=-1)
         sent = jnp.where(decided[..., None], term_axis, val_axis)  # (N,P,2)
 
-        # full-delivery round model: the neighbor count is one global sum;
-        # the fixpoint is reached in ≤ 2 spread rounds, 4 covers margins
-        INF = jnp.int32(9)
+        # full-delivery round model: the neighbor count is one global sum
+        INF = jnp.int32(SBV_INF_FULL)
         o, x = sbv_round_model(
-            sent, f, 4, lambda early: early.sum(axis=0)[None], INF
+            sent, f, SBV_ROUNDS_FULL,
+            lambda early: early.sum(axis=0)[None], INF,
         )
         binv_j, pref_true = aux_pref_from_crossings(x, INF)  # (N, P, 2)
         bin_vals = binv_j.any(axis=0)  # (P, 2) — same set at fixpoint

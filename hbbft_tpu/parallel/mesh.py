@@ -277,13 +277,15 @@ def make_sharded_aba_step(aba, mesh):
         # the node rows sharded: the neighbor count is a psum, everything
         # else stays local — bit-equal to the single-device step (tests)
         from hbbft_tpu.parallel.aba import (
+            SBV_INF_FULL,
+            SBV_ROUNDS_FULL,
             aux_pref_from_crossings,
             sbv_round_model,
         )
 
-        INF = jnp.int32(9)
+        INF = jnp.int32(SBV_INF_FULL)
         o, x = sbv_round_model(
-            sent, f, 4,
+            sent, f, SBV_ROUNDS_FULL,
             lambda early: _psum(early.sum(axis=0))[None], INF,
         )
         binv_j, pref_true = aux_pref_from_crossings(x, INF)  # (per, P, 2)
@@ -354,13 +356,15 @@ def make_sharded_aba_step(aba, mesh):
         # BatchedAba.epoch_step (tests)
         from hbbft_tpu.parallel.aba import (
             aux_pref_from_crossings,
+            sbv_inf_masked,
             sbv_round_model,
+            sbv_rounds_masked,
         )
 
-        INF = jnp.int32(n + 4)
+        INF = jnp.int32(sbv_inf_masked(n))
         bmi = bm.astype(jnp.int32)
         o, x = sbv_round_model(
-            sent, f, n + 2,
+            sent, f, sbv_rounds_masked(n),
             lambda early: jnp.einsum(
                 "ipv,ijp->jpv", _gather_nodes(early, axes), bmi
             ),

@@ -510,7 +510,10 @@ class BatchedRbc:
         1. encode + root-only Merkle commit; echo validity as a direct
            comparison of the received shard against the commitment (the
            simulator's god-view equivalent of per-proof verification —
-           a proof verifies iff the shard matches what was committed);
+           a proof verifies iff the shard matches what was committed; the
+           per-receiver verify work a deployment performs is charged by
+           ``CostModel.batched_epoch_estimate``'s proof-verification term,
+           so the shortcut is cost-accounted, not dropped);
         2. reconstruct (identity decode where the data rows survived —
            the overwhelmingly common case; host GF(2^16) decode for the
            stragglers), re-encode, root re-check, framing check.

@@ -76,6 +76,9 @@ class CostModel:
 
     bandwidth_bps: float = 1e9
     cpu_lag_s: float = 1e-5
+    # per-digest CPU charge for the proof-verification term of
+    # batched_epoch_estimate (≈ one short SHA3-256 on a single core)
+    hash_lag_s: float = 5e-7
 
     def charge(self, wire_bytes: int) -> float:
         return self.cpu_lag_s + 8.0 * wire_bytes / self.bandwidth_bps
@@ -98,7 +101,14 @@ class CostModel:
           charged at 8 framed bytes per vote (1 payload byte + wire/header
           overhead), and on coin epochs N×N 96-byte G2 shares — the coin
           term charges at least one coin epoch even when aba_epochs < 3,
-          covering the schedule's mandatory first threshold-coin flip.
+          covering the schedule's mandatory first threshold-coin flip;
+        - Merkle proof VERIFICATION compute: (depth+1) digests for each of
+          the N×N received echo proofs (plus N Values).  The large-N
+          full-delivery simulator path replaces per-receiver proof checks
+          with a god-view commitment comparison (parallel/rbc.py::
+          _run_large — the verify itself is the check a real receiver
+          performs, SURVEY §3.2 HOT), so the work a deployment would do is
+          charged HERE rather than silently dropped.
         """
         k = max(n - 2 * f, 1)
         shard = max(2, -(-(4 + payload_bytes) // k))
@@ -114,7 +124,12 @@ class CostModel:
             + max(aba_epochs // 3, 1) * n * n
         )
         total_b = value_b + echo_b + ready_b + votes_b + coin_b
-        return msgs * self.cpu_lag_s + 8.0 * total_b / self.bandwidth_bps
+        verify_digests = (n * n + n) * (depth + 1)
+        return (
+            msgs * self.cpu_lag_s
+            + 8.0 * total_b / self.bandwidth_bps
+            + verify_digests * self.hash_lag_s
+        )
 
 
 def wire_size(payload: Any) -> int:

@@ -42,7 +42,10 @@ def enable_compilation_cache(path: Optional[str] = None) -> None:
                             ".jax_cache")
     try:
         jax.config.update("jax_compilation_cache_dir", path)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+        # 1 s threshold: the suite re-pays hundreds of 1–5 s compiles per
+        # process otherwise; the cache entries are small relative to the
+        # ladder executables that dominate the directory
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:  # pragma: no cover - older jax / unsupported backend
         pass

@@ -19,6 +19,12 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Plain bitwise MSM ladders in the suite: the windowed ladder's XLA graph
+# costs ~250 s to compile cold on this CPU backend vs ~30 s plain (both
+# exact; crypto/batch.py documents the knob).  The windowed FUNCTION stays
+# covered by its direct tests (test_fp381_mxu / test_gcurve) and by every
+# TPU bench run; only the _MsmCache integration uses plain here.
+os.environ.setdefault("HBBFT_PLAIN_LADDER", "1")
 
 import jax
 

@@ -9,7 +9,11 @@ it for the measured baseline row.  Run it on an otherwise idle box:
 """
 import json, os, random, sys, time
 
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -62,7 +66,12 @@ path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                     "BASELINE_MEASURED.json")
 data = {}
 if os.path.exists(path):
-    data = json.load(open(path))
+    with open(path) as fh:
+        data = json.load(fh)
 data["hb_epoch64_host"] = out
-json.dump(data, open(path, "w"), indent=1)
+# atomic replace: a kill mid-write must not truncate the committed record
+tmp = path + ".tmp"
+with open(tmp, "w") as fh:
+    json.dump(data, fh, indent=1)
+os.replace(tmp, path)
 print(json.dumps(out))
