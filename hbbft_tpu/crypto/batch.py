@@ -369,7 +369,12 @@ def batch_tpke_decrypt(pks, cts, secret_shares):
 # device ladder beats the per-mul C++ oracle; below it, host wins on launch
 # overhead.  Both paths are exact, so dispatch is purely a speed choice.
 
-DEVICE_DKG_MIN_BATCH = 4096  # (t+1)²; ~t ≥ 63 → N ≥ ~190 networks
+# Round-5 recalibration: the ADX/GLV-accelerated C++ oracle does ~0.15 ms
+# per scalar-mul, so the single-chip device ladder only wins past ~16k rows
+# (measured: dkg256's 7396-mul row is 1.75 s device vs 1.58 s host).  On a
+# mesh (`use_mesh`) the rows shard across chips and the crossover drops;
+# this constant governs the single-chip default.
+DEVICE_DKG_MIN_BATCH = 16384  # (t+1)²; ~t ≥ 127 → N ≥ ~382 networks
 
 
 def _device_worthwhile(batch_size: int, min_batch: Optional[int] = None) -> bool:
