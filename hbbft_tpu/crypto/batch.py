@@ -312,6 +312,31 @@ def use_mesh(mesh) -> None:
 DEVICE_DECRYPT_MIN_BATCH = 8192
 
 
+# id(pks) → (pks, share-index tuple, master).  The strong pks reference
+# keeps the id from being recycled while the entry lives (same pattern as
+# parallel/aba._MASTER_CACHE); bounded for long multi-network processes.
+# The O(t²) Lagrange-coefficient interpolation costs ~0.6 s per call at
+# t=1365 — recomputing it every epoch would dominate the decrypt phase.
+_MASTER_CACHE = {}
+_MASTER_CACHE_MAX = 64
+
+
+def _master_for(pks, items) -> int:
+    from hbbft_tpu.crypto import tc
+
+    # key on the share VALUES too (cheap tuple hash) — a share refresh at
+    # the same indices must not serve a stale master
+    key_shares = tuple((i, sk.scalar) for i, sk in items)
+    hit = _MASTER_CACHE.get(id(pks))
+    if hit is not None and hit[0] is pks and hit[1] == key_shares:
+        return hit[2]
+    master = tc.master_secret_from_shares(key_shares)
+    if len(_MASTER_CACHE) >= _MASTER_CACHE_MAX:
+        _MASTER_CACHE.clear()
+    _MASTER_CACHE[id(pks)] = (pks, key_shares, master)
+    return master
+
+
 def batch_tpke_decrypt(pks, cts, secret_shares):
     """God-view batched TPKE decryption of many ciphertexts at once.
 
@@ -333,9 +358,7 @@ def batch_tpke_decrypt(pks, cts, secret_shares):
         raise ValueError(f"need {t + 1} shares, got {len(items)}")
     if not cts:
         return []
-    master = tc.master_secret_from_shares(
-        (i, sk.scalar) for i, sk in items
-    )
+    master = _master_for(pks, items)
     if _device_worthwhile(len(cts), DEVICE_DECRYPT_MIN_BATCH):
         masks = _CACHE.g1_mul_batch(
             [ct.u for ct in cts], [master] * len(cts)
@@ -344,14 +367,16 @@ def batch_tpke_decrypt(pks, cts, secret_shares):
     else:
         nat = c._native()
         if nat is not None:
-            # one C call for the whole batch (GLV ladders, GIL released)
-            mask_bytes = nat.bls_tpke_mask_batch(
-                master, [c.g1_to_bytes(ct.u) for ct in cts]
+            # the WHOLE decrypt (GLV mask fold + KDF + XOR) is one C call
+            # with the GIL released
+            return nat.bls_tpke_decrypt_batch(
+                master,
+                [c.g1_to_bytes(ct.u) for ct in cts],
+                [ct.v for ct in cts],
             )
-        else:
-            mask_bytes = [
-                c.g1_to_bytes(c.g1_mul(ct.u, master)) for ct in cts
-            ]
+        mask_bytes = [
+            c.g1_to_bytes(c.g1_mul(ct.u, master)) for ct in cts
+        ]
     out = []
     for ct, mb in zip(cts, mask_bytes):
         stream = tc._kdf_stream(mb, len(ct.v))

@@ -1876,6 +1876,36 @@ int bls_g2_in_subgroup(const uint8_t* p193) {
   return (Mod<6>::cmp(a.a, b.a) == 0 && Mod<6>::cmp(a.b, b.b) == 0) ? 1 : 0;
 }
 
+// Full batched TPKE decrypt with the master-scalar fold: out_i = V_i ⊕
+// KDF([s]·U_i) — GLV ladders, KDF, and XOR in one call (GIL released).
+// us: count×97; vs: concatenated V bytes with vlens[i] lengths; out: same
+// layout as vs.
+int bls_tpke_decrypt_batch(const uint8_t* s_be32, const uint8_t* us97,
+                           const uint8_t* vs, const int64_t* vlens, int count,
+                           uint8_t* out) {
+  init_all();
+  u64 k[4], km[4], kr[4];
+  fr_from_be32(s_be32, k);
+  FR.from_raw(k, km);
+  FR.to_raw(km, kr);
+  const uint8_t* vp = vs;
+  uint8_t* op = out;
+  for (int i = 0; i < count; ++i) {
+    G1 u, m;
+    if (!g1_read(us97 + 97 * i, u)) return -1;
+    g1_mul_glv(u, kr, m);
+    uint8_t mask_bytes[97];
+    g1_write(m, mask_bytes);
+    int64_t len = vlens[i];
+    std::vector<uint8_t> stream(len);
+    kdf_stream(mask_bytes, len, stream.data());
+    for (int64_t j = 0; j < len; ++j) op[j] = vp[j] ^ stream[j];
+    vp += len;
+    op += len;
+  }
+  return 0;
+}
+
 // Common-coin batch: out_bits[i] = parity(SHA3(g2_bytes([s]·H_G2(nonce_i))))
 // — the master-scalar god-view fold of ThresholdSign (parallel/aba.py::
 // coin_for), one call for a whole epoch's instance axis.

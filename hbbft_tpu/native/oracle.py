@@ -82,6 +82,7 @@ class NativeOracle:
             ("bls_coin_batch", [u8p, u8p, i64p, i, u8p], i),
             ("bls_g1_in_subgroup", [u8p], i),
             ("bls_g2_in_subgroup", [u8p], i),
+            ("bls_tpke_decrypt_batch", [u8p, u8p, u8p, i64p, i, u8p], i),
         ]:
             fn = getattr(lib, name)
             fn.argtypes = args
@@ -351,6 +352,31 @@ class NativeOracle:
         rc = self._lib.bls_g2_in_subgroup(self._p(self._arr(p)))
         assert rc >= 0
         return bool(rc)
+
+    def bls_tpke_decrypt_batch(self, scalar: int, us, vs) -> list:
+        """plaintexts[i] = vs[i] ⊕ KDF([scalar]·U_i) — the whole batched
+        decrypt (GLV mask fold + KDF + XOR) in one native call."""
+        if not us:
+            return []
+        ubuf = np.concatenate([self._arr(u) for u in us])
+        vlens = (ctypes.c_int64 * len(vs))(*[len(v) for v in vs])
+        vcat = self._arr(b"".join(vs) or b"\0")
+        total = sum(len(v) for v in vs)
+        out = self._buf(max(total, 1))
+        # not inside an assert: under python -O a skipped call would return
+        # silently-plausible all-zero plaintexts
+        rc = self._lib.bls_tpke_decrypt_batch(
+            self._p(self._arr(scalar.to_bytes(32, "big"))),
+            self._p(ubuf), self._p(vcat), vlens, len(us), self._p(out),
+        )
+        if rc != 0:
+            raise ValueError("bls_tpke_decrypt_batch failed (bad point?)")
+        ob = out.tobytes()
+        res, off = [], 0
+        for v in vs:
+            res.append(ob[off:off + len(v)])
+            off += len(v)
+        return res
 
     def bls_coin_batch(self, scalar: int, nonces) -> list:
         """parity(SHA3(g2_bytes([scalar]·H_G2(nonce)))) per nonce — a whole

@@ -462,6 +462,7 @@ class BatchedRbc:
         ident = np.asarray(ident_d)
         ready = ec >= (n - f)
         can_decode = ready & (ec >= k)
+        all_match = bool((ec == n).all())  # every shard equals commitment
         if bool(ident.all()):
             data_rec = sent[:, :k, :]
         else:
@@ -469,9 +470,30 @@ class BatchedRbc:
                 np.asarray(sent), np.asarray(vv), can_decode, ident
             ))
 
-        out_data, root_ok, frame_ok = stage_b_fn(data_rec, sent, vv, root)
-        root_ok = np.asarray(root_ok)
-        frame_ok = np.asarray(frame_ok)
+        if all_match:
+            # Stage B is a TAUTOLOGY here: vv all-true means sent == the
+            # committed shards everywhere, so full_obj == shards and the
+            # re-built root equals the stage-A root by construction.  Only
+            # the framing check has content — ~half the large-N device
+            # work (a full re-encode + a 16.8M-leaf Merkle build at
+            # N=4096) skipped on the clean path.
+            out_data = np.asarray(data_rec)  # ONE device→host transfer
+            root_ok = np.ones(ec.shape, dtype=bool)
+            flat = out_data.reshape(ec.shape[0], -1)
+            kb = flat.shape[1]  # k·B payload bytes per proposer
+            ln = (
+                flat[:, 0].astype(np.uint32) << 24
+                | flat[:, 1].astype(np.uint32) << 16
+                | flat[:, 2].astype(np.uint32) << 8
+                | flat[:, 3].astype(np.uint32)
+            )
+            frame_ok = ln <= np.uint32(kb - 4)
+        else:
+            out_data, root_ok, frame_ok = stage_b_fn(
+                data_rec, sent, vv, root
+            )
+            root_ok = np.asarray(root_ok)
+            frame_ok = np.asarray(frame_ok)
         delivered = can_decode & root_ok & frame_ok
         fault = can_decode & ~(root_ok & frame_ok)
         P = ec.shape[0]
