@@ -71,30 +71,15 @@ class ReedSolomon:
 
         Mirrors ``ReedSolomon::reconstruct(&mut Vec<Option<_>>)``.
         """
-        present = [i for i, s in enumerate(shards) if s is not None]
-        if len(present) < self.data_shards:
-            raise ValueError(
-                f"too few shards: {len(present)} < {self.data_shards}"
+        def decode(sub, use):
+            dec = self._decode_matrix(tuple(use))
+            data = gf256.gf_matmul_np(dec, sub)
+            return (
+                gf256.gf_matmul_np(self.matrix, data)
+                if self.parity_shards else data
             )
-        if len(shards) != self.total_shards:
-            raise ValueError("wrong shard count")
-        shard_len = len(shards[present[0]])
-        if any(len(shards[i]) != shard_len for i in present):
-            raise ValueError("inconsistent shard lengths")
-        use = present[: self.data_shards]
-        dec = self._decode_matrix(tuple(use))  # (data, data) mapping use→data
-        sub = np.stack(
-            [np.frombuffer(shards[i], dtype=np.uint8) for i in use]
-        )  # (data, B)
-        data = gf256.gf_matmul_np(dec, sub)  # (data, B)
-        full = gf256.gf_matmul_np(self.matrix, data) if self.parity_shards else data
-        out: List[bytes] = []
-        for i in range(self.total_shards):
-            if shards[i] is not None:
-                out.append(bytes(shards[i]))
-            else:
-                out.append(full[i].tobytes())
-        return out
+
+        return _reconstruct_optional(self, shards, decode)
 
     def _decode_matrix(self, use: Tuple[int, ...]) -> np.ndarray:
         """Inverse of the encode-matrix rows for the surviving shard set."""
@@ -238,6 +223,54 @@ class ReedSolomon16:
         dec = self.decode_matrix(tuple(use))
         S = self._to_symbols(np.asarray(survivors, dtype=np.uint8))
         return self._from_symbols(self.gf.gf_matmul_np(dec, S))
+
+    def reconstruct_np(
+        self, shards: Sequence[Optional[bytes]]
+    ) -> List[bytes]:
+        """Fill in missing (None) shards; needs ≥ data_shards present.
+
+        Same contract as :meth:`ReedSolomon.reconstruct_np` — the
+        object-mode ``Broadcast`` decode path calls this, so the GF(2^16)
+        coder must offer it too (found by the round-5 large-N masked
+        property sweep: object mode at N > 256 previously had no erasure
+        reconstruction at all)."""
+        def decode(sub, use):
+            return self.encode_np(self.reconstruct_data_np(sub, use))
+
+        return _reconstruct_optional(self, shards, decode, even_len=True)
+
+
+def _reconstruct_optional(coder, shards, decode, even_len: bool = False):
+    """Shared fill-in-missing-shards driver for both coders.
+
+    ``decode(sub, use) -> full`` rebuilds all shards from the first
+    data_shards survivors; validation (counts, lengths, the GF(2^16)
+    even-length requirement) lives here exactly once.
+    """
+    present = [i for i, s in enumerate(shards) if s is not None]
+    if len(present) < coder.data_shards:
+        raise ValueError(
+            f"too few shards: {len(present)} < {coder.data_shards}"
+        )
+    if len(shards) != coder.total_shards:
+        raise ValueError("wrong shard count")
+    shard_len = len(shards[present[0]])
+    if (even_len and shard_len % 2) or any(
+        len(shards[i]) != shard_len for i in present
+    ):
+        raise ValueError("inconsistent/odd shard lengths")
+    use = tuple(present[: coder.data_shards])
+    sub = np.stack(
+        [np.frombuffer(shards[i], dtype=np.uint8) for i in use]
+    )
+    full = decode(sub, use)
+    out: List[bytes] = []
+    for i in range(coder.total_shards):
+        if shards[i] is not None:
+            out.append(bytes(shards[i]))
+        else:
+            out.append(full[i].tobytes())
+    return out
 
 
 @functools.lru_cache(maxsize=256)

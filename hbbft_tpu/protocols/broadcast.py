@@ -327,10 +327,24 @@ class Broadcast(ConsensusProtocol):
             for nid, proof in self.echos.items():
                 if proof.root_hash == root:
                     shards[proof.index] = proof.value
+            # Byzantine senders may echo duplicate SLOTS, so the sender
+            # count above can exceed the distinct-slot count — too few
+            # distinct slots stays RETRIABLE (honest echoes still coming)
+            if sum(s is not None for s in shards) < self.data_shard_num:
+                continue
             try:
                 full = self.coder.reconstruct_np(shards)
             except ValueError:
-                continue
+                # ≥ k distinct committed slots in hand, yet reconstruction
+                # is impossible: a PERMANENT commitment defect (the
+                # proposer Merkle-committed odd/inconsistent-length
+                # shards).  Treating it as retriable would livelock every
+                # honest node against such a proposer (round-5 review
+                # finding); fault it like the root-mismatch case.
+                self.fault = True
+                return Step.from_fault(
+                    self.proposer_id, FaultKind.InvalidProof
+                )
             # re-encode & verify the root (defends against a faulty proposer
             # whose shards don't form a consistent codeword)
             tree = MerkleTree.from_vec(full)
@@ -357,9 +371,16 @@ class Broadcast(ConsensusProtocol):
 
 
 def _frame_value(value: bytes, data_shards: int) -> np.ndarray:
-    """value → (data_shards, B) uint8: 4-byte length prefix + value + zeros."""
+    """value → (data_shards, B) uint8: 4-byte length prefix + value + zeros.
+
+    The shard length rounds up to EVEN, matching the array-mode
+    ``parallel.rbc.frame_values``: the GF(2^16) coder (networks beyond the
+    reference's 256-shard limit) works in u16 symbols, and an odd length
+    would fail its encode — a bug the round-5 large-N masked property
+    sweep found in object mode."""
     framed = len(value).to_bytes(4, "big") + value
-    shard_len = max(1, -(-len(framed) // data_shards))
+    shard_len = max(2, -(-len(framed) // data_shards))
+    shard_len += shard_len % 2
     framed = framed.ljust(data_shards * shard_len, b"\0")
     return np.frombuffer(framed, dtype=np.uint8).reshape(data_shards, shard_len)
 

@@ -169,3 +169,68 @@ def test_gf16_reconstruct_roundtrip_random_erasures(seed, n):
     survivors = np.stack([shards[i] for i in keep])
     got = coder.reconstruct_data_np(survivors, keep)
     np.testing.assert_array_equal(got, data)
+
+
+@given(
+    st.integers(min_value=0, max_value=2**31 - 1),
+    st.sampled_from([0.05, 0.15, 0.3]),
+)
+@settings(max_examples=2, deadline=None,  # the 264-node object oracle costs
+          # ~25 s/example; two keep the default suite within budget
+          suppress_health_check=[HealthCheck.too_slow])
+def test_large_n_masked_rbc_equals_object_oracle(seed, drop):
+    """The GF(2^16) masked path (N > 256) under RANDOM delivery schedules,
+    verdict-for-verdict against the object-mode oracle — round-4 Weak #6:
+    the field switch beyond the reference's 256-shard limit was previously
+    exercised by fixed examples only.
+
+    Fixed (n, P, receivers) keep one compiled executable across examples;
+    the proposer count is small and the decode is ``receivers``-bounded —
+    exactly how callers bound the O(N³) masked cost at this scale.
+    """
+    import random as pyrandom
+
+    from hbbft_tpu.parallel.rbc import unframe_value
+
+    n, P = 264, 2
+    f = (n - 1) // 3
+    rng = np.random.default_rng(seed)
+    vals_rng = pyrandom.Random(seed)
+    values = [
+        bytes(vals_rng.randrange(256) for _ in range(9 + 5 * p))
+        for p in range(P)
+    ]
+    vm = np.ones((P, n), dtype=bool)
+    em = rng.random((n, n, P)) >= drop
+    rm = rng.random((n, n, P)) >= drop
+    for i in range(n):
+        em[i, i, :] = True
+        rm[i, i, :] = True
+    receivers = np.array([0, 5], dtype=np.int32)
+
+    rbc = BatchedRbc(n, f)
+    assert rbc.large  # the GF(2^16) regime
+    from hbbft_tpu.parallel.rbc import frame_values
+
+    data = frame_values(values, rbc.k)
+    out = jax.jit(rbc.run, static_argnames=())(
+        jnp.asarray(data),
+        value_mask=jnp.asarray(vm),
+        echo_mask=jnp.asarray(em),
+        ready_mask=jnp.asarray(rm),
+        receivers=jnp.asarray(receivers),
+    )
+    delivered = np.asarray(out["delivered"])
+    fault = np.asarray(out["fault"])
+    datr = np.asarray(out["data"])
+
+    delivered_o, outputs_o, fault_o = run_object_rbc(n, values, vm, em, rm)
+
+    # the decode ran only for `receivers`; counting verdicts are global
+    for row, j in enumerate(receivers):
+        assert (delivered[row] == delivered_o[j]).all(), (seed, j)
+        assert (fault[row] == fault_o[j]).all(), (seed, j)
+        for p in range(P):
+            if delivered_o[j][p]:
+                got = unframe_value(datr[row, p])
+                assert got == outputs_o[(j, p)], (seed, j, p)
