@@ -384,6 +384,42 @@ def batch_tpke_decrypt(pks, cts, secret_shares):
     return out
 
 
+def batch_tpke_check_decrypt(pks, payloads, secret_shares):
+    """Wire-validate + decrypt raw ciphertext payload bytes in one pass —
+    the HoneyBadger epoch's parse phase (``Ciphertext.from_bytes`` per
+    accepted proposer: canonical/on-curve/subgroup checks for U and W)
+    fused with the master-scalar decrypt into ONE native call with the GIL
+    released throughout.  Semantics match ``Ciphertext.from_bytes`` then
+    :func:`batch_tpke_decrypt` exactly: raises ``ValueError`` on any
+    malformed payload (re-parsed per-item for the precise message).
+    Returns the plaintext list, aligned with ``payloads``.
+    """
+    from hbbft_tpu.crypto import tc
+
+    t = pks.threshold()
+    items = sorted(secret_shares)[: t + 1]
+    if len(items) < t + 1:
+        raise ValueError(f"need {t + 1} shares, got {len(items)}")
+    if not payloads:
+        return []
+    nat = c._native()
+    exact = all(
+        len(p) >= 294
+        and int.from_bytes(p[290:294], "big") == len(p) - 294
+        for p in payloads
+    )
+    if nat is not None and exact:
+        res = nat.bls_tpke_check_decrypt_batch(
+            _master_for(pks, items), payloads
+        )
+        if res is not None:
+            return res
+    # ground-truth path: per-item parse (raises with the precise error on
+    # the first malformed payload), then the batched decrypt
+    cts = [tc.Ciphertext.from_bytes(p) for p in payloads]
+    return batch_tpke_decrypt(pks, cts, secret_shares)
+
+
 # --------------------------------------------------------------------------
 # DKG commitment evaluation (SyncKeyGen hot loops)
 # --------------------------------------------------------------------------

@@ -934,6 +934,10 @@ def g1_in_subgroup(pt) -> bool:
 def g1_from_bytes(data: bytes):
     if data[0] == 0x40:
         return None
+    if data[0] != 0:
+        # strict decode: the only defined flags are 0x00 and 0x40 (the
+        # native g1_read enforces the same)
+        raise ValueError("invalid G1 flag byte")
     x = int.from_bytes(data[1:49], "big")
     y = int.from_bytes(data[49:97], "big")
     if x >= P or y >= P:
@@ -965,6 +969,8 @@ def g2_to_bytes(pt) -> bytes:
 def g2_from_bytes(data: bytes):
     if data[0] == 0x40:
         return None
+    if data[0] != 0:
+        raise ValueError("invalid G2 flag byte")
     vals = [int.from_bytes(data[1 + i * 48 : 49 + i * 48], "big") for i in range(4)]
     if any(v >= P for v in vals):
         raise ValueError("non-canonical G2 coordinates")

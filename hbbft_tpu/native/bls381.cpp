@@ -1512,6 +1512,127 @@ static void lagrange_at_zero(const uint32_t* idx, int count, u64 out[][4]) {
   }
 }
 
+// ---------------------------------------------------------------------------
+// Wire validation (mirrors crypto/bls12_381.py g1_from_bytes/g2_from_bytes:
+// canonical coordinates, on-curve, r-order subgroup)
+// ---------------------------------------------------------------------------
+
+// Parse 48 big-endian bytes into Montgomery form; false if >= p.
+static bool fp_canonical_from_be48(const uint8_t* in, u64* out) {
+  u64 raw[6] = {0};
+  for (int i = 0; i < 6; ++i) {
+    u64 limb = 0;
+    for (int b = 0; b < 8; ++b) limb = (limb << 8) | in[i * 8 + b];
+    raw[5 - i] = limb;
+  }
+  if (Mod<6>::cmp(raw, BLS_P) >= 0) return false;
+  FP.from_raw(raw, out);
+  return true;
+}
+
+static bool g1_on_curve(const G1& p) {  // affine input (z = 1)
+  if (p.inf) return true;
+  u64 y2[6], x3[6];
+  FP.sqr(p.y, y2);
+  FP.sqr(p.x, x3);
+  FP.mul(x3, p.x, x3);
+  FP.add(x3, B1_M, x3);
+  return Mod<6>::cmp(y2, x3) == 0;
+}
+
+static bool g2_on_curve(const G2& p) {  // affine input (z = 1)
+  if (p.inf) return true;
+  Fp2 y2, x3;
+  f2_sqr(p.y, y2);
+  f2_sqr(p.x, x3);
+  f2_mul(x3, p.x, x3);
+  f2_add(x3, B2_M, x3);
+  return Mod<6>::cmp(y2.a, x3.a) == 0 && Mod<6>::cmp(y2.b, x3.b) == 0;
+}
+
+// Eigenvalue subgroup membership (on-curve input assumed; soundness notes
+// at the exported bls_g1_in_subgroup/bls_g2_in_subgroup below).
+static bool g1_subgroup_ok(const G1& p) {
+  if (p.inf) return true;
+  // φ(P) == [λ]P with λ = x²−1: [x²]P costs two sparse [|x|] ladders
+  // (x has Hamming weight 6 → 6 adds each) instead of a dense 127-bit
+  // ladder's ~64 adds, then one mixed subtraction of P.
+  G1 phi, lam, xp;
+  u64 xk = BLS_X_ABS;
+  g1_mul_limbs(p, &xk, 1, xp);    // [|x|]P
+  g1_mul_limbs(xp, &xk, 1, lam);  // [x²]P
+  G1 negp = p;
+  FP.neg(p.y, negp.y);
+  g1_add(lam, negp, lam);        // [x²−1]P
+  g1_endo(p, phi);
+  // g1_eq via cross-multiplied Jacobians
+  if (phi.inf != lam.inf) return false;
+  if (phi.inf) return true;
+  u64 z1z1[6], z2z2[6], a[6], b[6], t[6];
+  FP.sqr(phi.z, z1z1);
+  FP.sqr(lam.z, z2z2);
+  FP.mul(phi.x, z2z2, a);
+  FP.mul(lam.x, z1z1, b);
+  if (Mod<6>::cmp(a, b) != 0) return false;
+  FP.mul(phi.y, lam.z, t);
+  FP.mul(t, z2z2, a);
+  FP.mul(lam.y, phi.z, t);
+  FP.mul(t, z1z1, b);
+  return Mod<6>::cmp(a, b) == 0;
+}
+
+static bool g2_subgroup_ok(const G2& p) {
+  if (p.inf) return true;
+  G2 ps, xp;
+  g2_psi(p, ps);
+  g2_mul_xabs(p, xp);
+  g2_neg_pt(xp, xp);  // [x]P (x < 0)
+  if (ps.inf != xp.inf) return false;
+  if (ps.inf) return true;
+  Fp2 z1z1, z2z2, a, b, t;
+  f2_sqr(ps.z, z1z1);
+  f2_sqr(xp.z, z2z2);
+  f2_mul(ps.x, z2z2, a);
+  f2_mul(xp.x, z1z1, b);
+  if (Mod<6>::cmp(a.a, b.a) != 0 || Mod<6>::cmp(a.b, b.b) != 0) return false;
+  f2_mul(ps.y, xp.z, t);
+  f2_mul(t, z2z2, a);
+  f2_mul(xp.y, ps.z, t);
+  f2_mul(t, z1z1, b);
+  return Mod<6>::cmp(a.a, b.a) == 0 && Mod<6>::cmp(a.b, b.b) == 0;
+}
+
+// g1_read with the full wire checks — byte-for-byte the same accept set as
+// the Python g1_from_bytes (0x40 = infinity; flag byte must otherwise be 0;
+// coordinates canonical; on-curve; subgroup).
+static bool g1_read_checked(const uint8_t* in97, G1& o) {
+  if (in97[0] == 0x40) {
+    o.inf = true;
+    return true;
+  }
+  if (in97[0] != 0) return false;
+  o.inf = false;
+  if (!fp_canonical_from_be48(in97 + 1, o.x)) return false;
+  if (!fp_canonical_from_be48(in97 + 49, o.y)) return false;
+  memcpy(o.z, FP.one, sizeof(FP.one));
+  return g1_on_curve(o) && g1_subgroup_ok(o);
+}
+
+static bool g2_read_checked(const uint8_t* in193, G2& o) {
+  if (in193[0] == 0x40) {
+    o.inf = true;
+    return true;
+  }
+  if (in193[0] != 0) return false;
+  o.inf = false;
+  if (!fp_canonical_from_be48(in193 + 1, o.x.a)) return false;
+  if (!fp_canonical_from_be48(in193 + 49, o.x.b)) return false;
+  if (!fp_canonical_from_be48(in193 + 97, o.y.a)) return false;
+  if (!fp_canonical_from_be48(in193 + 145, o.y.b)) return false;
+  o.z = FP2_ONE_;
+  return g2_on_curve(o) && g2_subgroup_ok(o);
+}
+
 }  // namespace bls
 
 // ---------------------------------------------------------------------------
@@ -1831,49 +1952,14 @@ int bls_g1_in_subgroup(const uint8_t* p97) {
   init_all();
   G1 p;
   if (!g1_read(p97, p)) return -1;
-  if (p.inf) return 1;
-  G1 phi, lam;
-  g1_endo(p, phi);
-  u64 l[4] = {BLS_GLV_LAMBDA[0], BLS_GLV_LAMBDA[1], 0, 0};
-  g1_mul_limbs(p, l, 2, lam);
-  // g1_eq via cross-multiplied Jacobians
-  if (phi.inf != lam.inf) return 0;
-  if (phi.inf) return 1;
-  u64 z1z1[6], z2z2[6], a[6], b[6], t[6];
-  FP.sqr(phi.z, z1z1);
-  FP.sqr(lam.z, z2z2);
-  FP.mul(phi.x, z2z2, a);
-  FP.mul(lam.x, z1z1, b);
-  if (Mod<6>::cmp(a, b) != 0) return 0;
-  FP.mul(phi.y, lam.z, t);
-  FP.mul(t, z2z2, a);
-  FP.mul(lam.y, phi.z, t);
-  FP.mul(t, z1z1, b);
-  return Mod<6>::cmp(a, b) == 0 ? 1 : 0;
+  return g1_subgroup_ok(p) ? 1 : 0;
 }
 
 int bls_g2_in_subgroup(const uint8_t* p193) {
   init_all();
   G2 p;
   if (!g2_read(p193, p)) return -1;
-  if (p.inf) return 1;
-  G2 ps, xp;
-  g2_psi(p, ps);
-  g2_mul_xabs(p, xp);
-  g2_neg_pt(xp, xp);  // [x]P (x < 0)
-  if (ps.inf != xp.inf) return 0;
-  if (ps.inf) return 1;
-  Fp2 z1z1, z2z2, a, b, t;
-  f2_sqr(ps.z, z1z1);
-  f2_sqr(xp.z, z2z2);
-  f2_mul(ps.x, z2z2, a);
-  f2_mul(xp.x, z1z1, b);
-  if (Mod<6>::cmp(a.a, b.a) != 0 || Mod<6>::cmp(a.b, b.b) != 0) return 0;
-  f2_mul(ps.y, xp.z, t);
-  f2_mul(t, z2z2, a);
-  f2_mul(xp.y, ps.z, t);
-  f2_mul(t, z1z1, b);
-  return (Mod<6>::cmp(a.a, b.a) == 0 && Mod<6>::cmp(a.b, b.b) == 0) ? 1 : 0;
+  return g2_subgroup_ok(p) ? 1 : 0;
 }
 
 // Full batched TPKE decrypt with the master-scalar fold: out_i = V_i ⊕
@@ -1902,6 +1988,50 @@ int bls_tpke_decrypt_batch(const uint8_t* s_be32, const uint8_t* us97,
     for (int64_t j = 0; j < len; ++j) op[j] = vp[j] ^ stream[j];
     vp += len;
     op += len;
+  }
+  return 0;
+}
+
+// Wire-validate + decrypt `count` TPKE ciphertext payloads in ONE call —
+// the HoneyBadger epoch's parse and decrypt phases fused (GIL released for
+// both).  Each payload is Ciphertext.to_bytes layout: U(97) ‖ W(193) ‖
+// vlen(4, BE) ‖ V, with plens[i] the item's total length (vlen must be
+// exactly plens[i] − 294; callers with trailing bytes use the per-item
+// path).  Each item gets the FULL Ciphertext.from_bytes wire checks —
+// canonical coordinates, on-curve, r-order subgroup for BOTH U and W —
+// then out_i = V_i ⊕ KDF([s]·U_i) (the master-scalar decrypt fold).
+// Returns 0, or i+1 if item i is malformed (caller re-parses that item on
+// the Python path for the precise error).
+int bls_tpke_check_decrypt_batch(const uint8_t* s_be32,
+                                 const uint8_t* payloads,
+                                 const int64_t* plens, int count,
+                                 uint8_t* out) {
+  init_all();
+  u64 k[4], km[4], kr[4];
+  fr_from_be32(s_be32, k);
+  FR.from_raw(k, km);
+  FR.to_raw(km, kr);
+  const uint8_t* pp = payloads;
+  uint8_t* op = out;
+  for (int i = 0; i < count; ++i) {
+    int64_t plen = plens[i];
+    if (plen < 294) return i + 1;
+    int64_t vlen = ((int64_t)pp[290] << 24) | ((int64_t)pp[291] << 16) |
+                   ((int64_t)pp[292] << 8) | (int64_t)pp[293];
+    if (vlen != plen - 294) return i + 1;
+    G1 u;
+    G2 w;
+    if (!g1_read_checked(pp, u)) return i + 1;
+    if (!g2_read_checked(pp + 97, w)) return i + 1;
+    G1 m;
+    g1_mul_glv(u, kr, m);
+    uint8_t mask_bytes[97];
+    g1_write(m, mask_bytes);
+    std::vector<uint8_t> stream(vlen);
+    kdf_stream(mask_bytes, vlen, stream.data());
+    for (int64_t j = 0; j < vlen; ++j) op[j] = pp[294 + j] ^ stream[j];
+    pp += plen;
+    op += vlen;
   }
   return 0;
 }

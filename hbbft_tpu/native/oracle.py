@@ -83,6 +83,7 @@ class NativeOracle:
             ("bls_g1_in_subgroup", [u8p], i),
             ("bls_g2_in_subgroup", [u8p], i),
             ("bls_tpke_decrypt_batch", [u8p, u8p, u8p, i64p, i, u8p], i),
+            ("bls_tpke_check_decrypt_batch", [u8p, u8p, i64p, i, u8p], i),
         ]:
             fn = getattr(lib, name)
             fn.argtypes = args
@@ -376,6 +377,34 @@ class NativeOracle:
         for v in vs:
             res.append(ob[off:off + len(v)])
             off += len(v)
+        return res
+
+    def bls_tpke_check_decrypt_batch(self, scalar: int, payloads):
+        """Wire-validate (the full ``Ciphertext.from_bytes`` checks —
+        canonical coordinates, on-curve, r-order subgroup for U and W) and
+        decrypt many raw ciphertext payloads in ONE native call.  Returns
+        the plaintext list, or None if some item failed validation (the
+        caller re-parses per-item on the Python path for the precise
+        error).  Payloads must be exact ``Ciphertext.to_bytes`` output
+        (vlen == len − 294); hand anything else to the per-item path."""
+        if not payloads:
+            return []
+        plens = (ctypes.c_int64 * len(payloads))(*[len(p) for p in payloads])
+        cat = self._arr(b"".join(payloads))
+        total = sum(len(p) - 294 for p in payloads)
+        out = self._buf(max(total, 1))
+        rc = self._lib.bls_tpke_check_decrypt_batch(
+            self._p(self._arr(scalar.to_bytes(32, "big"))),
+            self._p(cat), plens, len(payloads), self._p(out),
+        )
+        if rc != 0:
+            return None
+        ob = out.tobytes()
+        res, off = [], 0
+        for p in payloads:
+            vlen = len(p) - 294
+            res.append(ob[off:off + vlen])
+            off += vlen
         return res
 
     def bls_coin_batch(self, scalar: int, nonces) -> list:

@@ -123,8 +123,16 @@ class BatchedAcs:
         import jax.numpy as jnp
 
         n = self.n
-        data = frame_values(list(values), self.rbc.k)
-        out = self._rbc_run(jnp.asarray(data), **rbc_kwargs)
+        if self.rbc.large and not any(
+            rbc_kwargs.get(m) is not None
+            for m in ("value_mask", "echo_mask", "ready_mask", "receivers")
+        ):
+            # large-N scale path: cross the link compact (payload bytes,
+            # not the ~87 %-zero (P, k, B) frame) and expand on device
+            data = self.rbc.upload_framed(list(values))
+        else:
+            data = jnp.asarray(frame_values(list(values), self.rbc.k))
+        out = self._rbc_run(data, **rbc_kwargs)
         delivered = out["delivered"]  # (N, P)
 
         if coin_fn is None:
@@ -274,8 +282,6 @@ class BatchedHoneyBadgerEpoch:
                           session_suffix: bytes = b"", **rbc_kwargs):
         """ACS + threshold-decrypt over pre-encrypted payloads (see
         :meth:`encrypt_phase`)."""
-        from hbbft_tpu.crypto import tc
-
         info0 = self.netinfo_map[self.ids[0]]
         pks = info0.public_key_set()
         session = self.session_id + session_suffix
@@ -350,13 +356,17 @@ class BatchedHoneyBadgerEpoch:
             if payload is None:
                 continue
             if encrypt:
-                pending.append((nid, tc.Ciphertext.from_bytes(payload)))
+                pending.append((nid, payload))
             else:
                 batch[nid] = payload
         if encrypt and pending:
-            # all accepted ciphertexts decrypt in one batched pass (device
-            # ladders above the size threshold, host loop below it)
-            from hbbft_tpu.crypto.batch import batch_tpke_decrypt
+            # parse + decrypt of all accepted ciphertexts fused into one
+            # native call: the per-proposer ``Ciphertext.from_bytes`` wire
+            # checks (canonical/on-curve/subgroup for U and W) and the
+            # master-scalar decrypt run back-to-back in C with the GIL
+            # released — at N=4096 this was a ~1 s host loop of Python
+            # bigint parsing on top of the 0.6 s decrypt call
+            from hbbft_tpu.crypto.batch import batch_tpke_check_decrypt
 
             shares = [
                 (
@@ -365,8 +375,8 @@ class BatchedHoneyBadgerEpoch:
                 )
                 for onid in self.ids[: t + 1]
             ]
-            plaintexts = batch_tpke_decrypt(
-                pks, [ct for _, ct in pending], shares
+            plaintexts = batch_tpke_check_decrypt(
+                pks, [pl for _, pl in pending], shares
             )
             for (nid, _), pt in zip(pending, plaintexts):
                 batch[nid] = pt

@@ -101,9 +101,10 @@ class BatchedQueueingHoneyBadger:
                 if tx not in self._seen:
                     self._seen.add(tx)
                     new.append(tx)
+        drop = frozenset(epoch_txs)
         with self._queue_lock:
             for q in self.queues.values():
-                q.remove_multiple(epoch_txs)
+                q.remove_multiple(drop)
         self.committed.extend(new)
         self.epoch += 1
         return new

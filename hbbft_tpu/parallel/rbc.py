@@ -440,6 +440,86 @@ class BatchedRbc:
             self._pbits_dev = jnp.asarray(self.coder._parity_bits)
         return self._pbits_dev
 
+    def upload_framed(self, values):
+        """Frame ``values`` like :func:`frame_values` but cross the
+        host→device link compact: the (P, k, B) frame is k·B bytes per
+        proposer (the GF(2^16) coder's minimum k·2 ≈ 2.7 KB at N=4096)
+        while the actual payload is 4+len(v) bytes — at the flagship
+        shape ~87 % of the naive upload is zero padding.  Uploads a
+        (P, L) buffer trimmed to the longest payload and zero-pads +
+        reshapes ON DEVICE; bit-identical to uploading
+        ``frame_values(values, k)``.
+        """
+        import jax.numpy as jnp
+
+        k = self.k
+        shard_len = max(2, max(-(-(4 + len(v)) // k) for v in values))
+        shard_len += shard_len % 2
+        # round the buffer width up (extra zeros are exactly what the
+        # device-side pad writes) so the expand jit-key set stays small
+        # across epochs with drifting payload sizes, like _fetch_data_compact
+        L = min(
+            -(-max(4 + len(v) for v in values) // 256) * 256,
+            k * shard_len,
+        )
+        P = len(values)
+        buf = np.zeros((P, L), dtype=np.uint8)
+        for i, v in enumerate(values):
+            stream = len(v).to_bytes(4, "big") + v
+            buf[i, : len(stream)] = np.frombuffer(stream, dtype=np.uint8)
+
+        def expand(b):
+            return jnp.pad(
+                b, ((0, 0), (0, k * shard_len - L))
+            ).reshape(P, k, shard_len)
+
+        return self._jit(("expand", P, L, shard_len), expand)(
+            jnp.asarray(buf)
+        )
+
+    def _fetch_data_compact(self, out_data, frame_ok=None):
+        """Device→host fetch of the shared (P, k, B) data row, bounded by
+        the per-proposer framed lengths: only ``max(ln)+4`` leading bytes
+        of each row cross the link (the rest of a frame is zero padding —
+        the inverse of :meth:`upload_framed`'s compaction).  Rows whose
+        framing check failed contribute nothing to the bound; their
+        returned bytes beyond the fetch window are zeros, which no caller
+        reads (not delivered).  ``frame_ok=None`` derives the framing
+        verdict from the fetched lengths (the all-match fast path, where
+        data rows are the committed shards verbatim).  Returns
+        ``(host (P, k, B) uint8 array, ln, frame_ok)``."""
+        import jax.numpy as jnp
+
+        P, k, B = out_data.shape
+        kb = k * B
+
+        def ln_of(d):
+            flat = d.reshape(P, kb)
+            return (
+                flat[:, 0].astype(jnp.uint32) << 24
+                | flat[:, 1].astype(jnp.uint32) << 16
+                | flat[:, 2].astype(jnp.uint32) << 8
+                | flat[:, 3].astype(jnp.uint32)
+            )
+
+        ln = np.asarray(self._jit(("ln", P, kb), ln_of)(out_data))
+        if frame_ok is None:
+            frame_ok = ln <= np.uint32(kb - 4)
+        ok_ln = ln[frame_ok]
+        maxb = int(min(kb, (int(ok_ln.max()) + 4) if ok_ln.size else 4))
+        # round the fetch window up so the slice jit-key set stays small
+        # across epochs with drifting payload sizes
+        maxb = int(min(kb, -(-maxb // 256) * 256))
+
+        def head(d):
+            return d.reshape(P, kb)[:, :maxb]
+
+        host = np.zeros((P, kb), dtype=np.uint8)
+        host[:, :maxb] = np.asarray(
+            self._jit(("head", P, kb, maxb), head)(out_data)
+        )
+        return host.reshape(P, k, B), ln, frame_ok
+
     def finish_large(self, stage_a_out, stage_b_fn):
         """Shared host orchestration of the large-N round: threshold
         decisions + straggler decode between stage A and stage B, then the
@@ -477,23 +557,15 @@ class BatchedRbc:
             # the framing check has content — ~half the large-N device
             # work (a full re-encode + a 16.8M-leaf Merkle build at
             # N=4096) skipped on the clean path.
-            out_data = np.asarray(data_rec)  # ONE device→host transfer
+            out_data, _, frame_ok = self._fetch_data_compact(data_rec)
             root_ok = np.ones(ec.shape, dtype=bool)
-            flat = out_data.reshape(ec.shape[0], -1)
-            kb = flat.shape[1]  # k·B payload bytes per proposer
-            ln = (
-                flat[:, 0].astype(np.uint32) << 24
-                | flat[:, 1].astype(np.uint32) << 16
-                | flat[:, 2].astype(np.uint32) << 8
-                | flat[:, 3].astype(np.uint32)
-            )
-            frame_ok = ln <= np.uint32(kb - 4)
         else:
             out_data, root_ok, frame_ok = stage_b_fn(
                 data_rec, sent, vv, root
             )
             root_ok = np.asarray(root_ok)
             frame_ok = np.asarray(frame_ok)
+            out_data, _, _ = self._fetch_data_compact(out_data, frame_ok)
         delivered = can_decode & root_ok & frame_ok
         fault = can_decode & ~(root_ok & frame_ok)
         P = ec.shape[0]
@@ -501,7 +573,7 @@ class BatchedRbc:
         return {
             "delivered": bc(delivered),
             "fault": bc(fault),
-            "data": np.asarray(out_data)[None],  # (1, P, k, B) shared row
+            "data": out_data[None],  # (1, P, k, B) shared row (host)
             "data_receivers": np.zeros((1,), dtype=np.int32),
             "root": np.asarray(root),
             "echo_count": bc(ec),

@@ -73,3 +73,68 @@ def test_batch_tpke_decrypt_host_and_device_paths(keys):
         assert BT.batch_tpke_decrypt(pks, [], shares) == []
     finally:
         BT.DEVICE_DECRYPT_MIN_BATCH = old
+
+
+def test_batch_tpke_check_decrypt_fused(keys):
+    """The fused native parse+decrypt (one C call doing the full
+    Ciphertext.from_bytes wire checks then the master-scalar decrypt)
+    matches the per-item path byte-for-byte and rejects exactly what
+    from_bytes rejects."""
+    from hbbft_tpu.crypto import batch as BT
+    from hbbft_tpu.crypto import bls12_381 as c
+    from hbbft_tpu.crypto import tc
+
+    rng, sks, pks = keys
+    pk = pks.public_key()
+    msgs = [b"fused%d" % i * (i + 1) for i in range(5)] + [b""]
+    cts = tc.tpke_encrypt_batch(pk, msgs, rng)
+    payloads = [ct.to_bytes() for ct in cts]
+    shares = [(i, sks.secret_key_share(i)) for i in range(pks.threshold() + 2)]
+
+    assert BT.batch_tpke_check_decrypt(pks, payloads, shares) == msgs
+    assert BT.batch_tpke_check_decrypt(pks, [], shares) == []
+
+    # U with an infinity flag decrypts identically on both paths
+    p_inf = tc.Ciphertext(None, b"payload", cts[0].w).to_bytes()
+    assert BT.batch_tpke_check_decrypt(pks, [p_inf], shares) == \
+        BT.batch_tpke_decrypt(
+            pks, [tc.Ciphertext.from_bytes(p_inf)], shares
+        )
+
+    def rejects(payload):
+        with pytest.raises(ValueError):
+            BT.batch_tpke_check_decrypt(
+                pks, [payloads[0], payload], shares
+            )
+
+    bad_u = bytearray(payloads[1]); bad_u[5] ^= 1          # off-curve U
+    rejects(bytes(bad_u))
+    bad_w = bytearray(payloads[1]); bad_w[97 + 5] ^= 1     # off-curve W
+    rejects(bytes(bad_w))
+    nc = bytearray(payloads[1])                            # non-canonical x
+    nc[1:49] = c.P.to_bytes(48, "big")
+    rejects(bytes(nc))
+    bad_flag = bytearray(payloads[1]); bad_flag[0] = 7     # bad flag byte
+    rejects(bytes(bad_flag))
+    rejects(payloads[1][:100])                             # truncated
+
+    # a non-subgroup but on-curve U must be rejected (the attack the
+    # subgroup check exists for); build one by skipping cofactor clearing
+    import hashlib
+
+    ctr = 0
+    while True:
+        x = int.from_bytes(
+            hashlib.sha3_256(b"nonsub%d" % ctr).digest() * 2, "big"
+        ) % c.P
+        ctr += 1
+        y2 = (pow(x, 3, c.P) + 4) % c.P
+        y = pow(y2, (c.P + 1) // 4, c.P)
+        if (y * y) % c.P != y2:
+            continue
+        pt = (x, y, 1)
+        if not c.g1_in_subgroup(pt):
+            break
+    evil = bytearray(payloads[1])
+    evil[:97] = c.g1_to_bytes(pt)
+    rejects(bytes(evil))
