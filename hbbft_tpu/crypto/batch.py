@@ -403,17 +403,29 @@ def batch_tpke_check_decrypt(pks, payloads, secret_shares):
     if not payloads:
         return []
     nat = c._native()
-    exact = all(
-        len(p) >= 294
+    # the native call requires exact framing (vlen == len − 294); route
+    # only the stragglers to the slow path so one odd payload cannot push
+    # the whole epoch back onto per-item Python parsing
+    exact_idx = [
+        i for i, p in enumerate(payloads)
+        if len(p) >= 294
         and int.from_bytes(p[290:294], "big") == len(p) - 294
-        for p in payloads
-    )
-    if nat is not None and exact:
+    ]
+    if nat is not None and exact_idx:
         res = nat.bls_tpke_check_decrypt_batch(
-            _master_for(pks, items), payloads
+            _master_for(pks, items), [payloads[i] for i in exact_idx]
         )
         if res is not None:
-            return res
+            if len(exact_idx) == len(payloads):
+                return res
+            out = [None] * len(payloads)
+            for i, pt in zip(exact_idx, res):
+                out[i] = pt
+            rest = [i for i in range(len(payloads)) if out[i] is None]
+            cts = [tc.Ciphertext.from_bytes(payloads[i]) for i in rest]
+            for i, pt in zip(rest, batch_tpke_decrypt(pks, cts, secret_shares)):
+                out[i] = pt
+            return out
     # ground-truth path: per-item parse (raises with the precise error on
     # the first malformed payload), then the batched decrypt
     cts = [tc.Ciphertext.from_bytes(p) for p in payloads]

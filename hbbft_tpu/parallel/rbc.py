@@ -453,8 +453,7 @@ class BatchedRbc:
         import jax.numpy as jnp
 
         k = self.k
-        shard_len = max(2, max(-(-(4 + len(v)) // k) for v in values))
-        shard_len += shard_len % 2
+        shard_len = _frame_shard_len(values, k)
         # round the buffer width up (extra zeros are exactly what the
         # device-side pad writes) so the expand jit-key set stays small
         # across epochs with drifting payload sizes, like _fetch_data_compact
@@ -465,7 +464,7 @@ class BatchedRbc:
         P = len(values)
         buf = np.zeros((P, L), dtype=np.uint8)
         for i, v in enumerate(values):
-            stream = len(v).to_bytes(4, "big") + v
+            stream = _frame_stream(v)
             buf[i, : len(stream)] = np.frombuffer(stream, dtype=np.uint8)
 
         def expand(b):
@@ -639,19 +638,28 @@ class BatchedRbc:
 # -- host-side helpers for tests / object-mode cross-checks -----------------
 
 
+def _frame_shard_len(values, k: int) -> int:
+    """The common shard length for a batch of values: rounded up to even
+    so the same framing feeds both the GF(2^8) and GF(2^16) (u16-symbol)
+    coders.  Single source of truth for :func:`frame_values` and the
+    compact ``upload_framed`` path — they must stay bit-identical."""
+    shard_len = max(2, max(-(-(4 + len(v)) // k) for v in values))
+    return shard_len + shard_len % 2
+
+
+def _frame_stream(v: bytes) -> bytes:
+    """One value's framed byte stream (4-byte length prefix + payload)."""
+    return len(v).to_bytes(4, "big") + v
+
+
 def frame_values(values, k: int) -> np.ndarray:
     """Frame a list of P byte-strings like the object-mode proposer does
     (4-byte length prefix, zero-padded) at one common shard length, so the
-    row-major byte stream stays contiguous: (P, k, B).
-
-    The shard length is rounded up to even so the same framing feeds both
-    the GF(2^8) and GF(2^16) (u16-symbol) coders."""
-    shard_len = max(2, max(-(-(4 + len(v)) // k) for v in values))
-    shard_len += shard_len % 2
+    row-major byte stream stays contiguous: (P, k, B)."""
+    shard_len = _frame_shard_len(values, k)
     out = np.zeros((len(values), k, shard_len), dtype=np.uint8)
     for i, v in enumerate(values):
-        stream = len(v).to_bytes(4, "big") + v
-        stream = stream.ljust(k * shard_len, b"\0")
+        stream = _frame_stream(v).ljust(k * shard_len, b"\0")
         out[i] = np.frombuffer(stream, dtype=np.uint8).reshape(k, shard_len)
     return out
 

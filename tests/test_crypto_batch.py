@@ -138,3 +138,17 @@ def test_batch_tpke_check_decrypt_fused(keys):
     evil = bytearray(payloads[1])
     evil[:97] = c.g1_to_bytes(pt)
     rejects(bytes(evil))
+
+    # mixed exact / non-exact framing: a payload with trailing bytes (which
+    # from_bytes tolerates by truncation) must decrypt via the straggler
+    # path WITHOUT pushing the exact ones off the fused native path
+    trailing = payloads[2] + b"\xEE"
+    mixed = [payloads[0], trailing, payloads[1]]
+    expect = [
+        BT.batch_tpke_decrypt(
+            pks, [tc.Ciphertext.from_bytes(p)], shares
+        )[0]
+        for p in mixed
+    ]
+    assert BT.batch_tpke_check_decrypt(pks, mixed, shares) == expect
+    assert expect[1] == msgs[2]  # the trailing byte is outside vlen

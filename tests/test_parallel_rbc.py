@@ -345,3 +345,49 @@ def test_full_delivery_fast_path_matches_masked_path():
         assert unframe_value(fast["data"][0, p]) == unframe_value(
             slow["data"][0, p]
         ) == values[p]
+
+
+def test_large_n_compact_transfers_bit_equal():
+    """upload_framed / _fetch_data_compact (the large-N tunnel compaction)
+    must be bit-equal to the naive full-frame path, across payload-size
+    edges: tiny values, values of very different lengths, and a value
+    filling the whole frame (fetch window == k*B)."""
+    n = 264
+    f = (n - 1) // 3
+    rbc = BatchedRbc(n, f)
+    kb = rbc.k * 2  # shard_len resolves to 2 for small payloads
+    values = [bytes([p % 251 + 1]) * (1 + (p * 37) % 60) for p in range(n)]
+    values[0] = b""                      # empty value
+    values[1] = bytes(range(256)) * ((kb - 4) // 256)  # near-full frame
+    # compact upload == naive frame, byte for byte
+    np.testing.assert_array_equal(
+        np.asarray(rbc.upload_framed(values)), frame_values(values, rbc.k)
+    )
+    out_naive = rbc._run_large(jnp.asarray(frame_values(values, rbc.k)))
+    out_comp = rbc._run_large(rbc.upload_framed(values))
+    np.testing.assert_array_equal(out_naive["delivered"], out_comp["delivered"])
+    np.testing.assert_array_equal(out_naive["data"], out_comp["data"])
+    assert out_comp["delivered"].all()
+    for p in (0, 1, 2, 100, n - 1):
+        assert unframe_value(out_comp["data"][0, p]) == values[p], p
+
+
+def test_large_n_compact_fetch_with_bad_framing():
+    """A proposer whose committed frame declares an absurd length must not
+    widen the compact fetch window, and must fault exactly like the naive
+    path (frame_ok false -> not delivered)."""
+    n = 264
+    f = (n - 1) // 3
+    rbc = BatchedRbc(n, f)
+    values = [bytes([p % 251 + 1]) * 3 for p in range(n)]
+    data = frame_values(values, rbc.k)
+    bad = data.copy()
+    bad[5, 0, :2] = 255  # length prefix now ~4 GB: frame check must fail
+    out = rbc._run_large(jnp.asarray(bad))
+    d = np.asarray(out["delivered"])
+    fa = np.asarray(out["fault"])
+    assert not d[0, 5] and fa[0, 5]
+    mask = np.ones(n, dtype=bool); mask[5] = False
+    assert d[0, mask].all() and not fa[0, mask].any()
+    for p in (0, 4, 6, n - 1):
+        assert unframe_value(out["data"][0, p]) == values[p]
