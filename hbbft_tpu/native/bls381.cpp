@@ -1212,6 +1212,111 @@ static bool g2_read(const uint8_t* in193, G2& o) {
   return true;
 }
 
+// -- batch affine writes ----------------------------------------------------
+// Montgomery batch inversion: one field inversion (a ~381-bit pow) + 3(m−1)
+// muls replaces m inversions.  The batch TPKE entry points spend ~10 % of
+// their time in per-point affine pow-inversions without this.
+
+static void fp_batch_inv(std::vector<std::array<u64, 6>>& vals) {
+  int m = (int)vals.size();
+  if (m == 0) return;
+  std::vector<std::array<u64, 6>> pre(m);
+  pre[0] = vals[0];
+  for (int i = 1; i < m; ++i)
+    FP.mul(pre[i - 1].data(), vals[i].data(), pre[i].data());
+  u64 acc[6];
+  FP.pow(pre[m - 1].data(), BLS_P_M2, 6, acc);
+  for (int i = m - 1; i > 0; --i) {
+    u64 vi[6];
+    memcpy(vi, vals[i].data(), sizeof(vi));
+    FP.mul(acc, pre[i - 1].data(), vals[i].data());
+    FP.mul(acc, vi, acc);
+  }
+  memcpy(vals[0].data(), acc, sizeof(acc));
+}
+
+static void f2_batch_inv(std::vector<Fp2>& vals) {
+  int m = (int)vals.size();
+  if (m == 0) return;
+  std::vector<Fp2> pre(m);
+  pre[0] = vals[0];
+  for (int i = 1; i < m; ++i) f2_mul(pre[i - 1], vals[i], pre[i]);
+  Fp2 acc;
+  f2_inv(pre[m - 1], acc);
+  for (int i = m - 1; i > 0; --i) {
+    Fp2 vi = vals[i];
+    f2_mul(acc, pre[i - 1], vals[i]);
+    f2_mul(acc, vi, acc);
+  }
+  vals[0] = acc;
+}
+
+// Affine-write m G1 points with ONE shared inversion chain; outs[i] gets the
+// same 97 bytes g1_write would produce.
+static void g1_write_batch(const std::vector<G1>& pts,
+                           const std::vector<uint8_t*>& outs) {
+  int m = (int)pts.size();
+  std::vector<std::array<u64, 6>> zs;
+  std::vector<int> idx;
+  zs.reserve(m);
+  idx.reserve(m);
+  for (int i = 0; i < m; ++i) {
+    if (pts[i].inf) {
+      memset(outs[i], 0, 97);
+      outs[i][0] = 0x40;
+    } else {
+      std::array<u64, 6> z;
+      memcpy(z.data(), pts[i].z, sizeof(z));
+      zs.push_back(z);
+      idx.push_back(i);
+    }
+  }
+  fp_batch_inv(zs);
+  for (size_t j = 0; j < idx.size(); ++j) {
+    int i = idx[j];
+    u64 zi2[6], x[6], y[6], t[6];
+    FP.sqr(zs[j].data(), zi2);
+    FP.mul(pts[i].x, zi2, x);
+    FP.mul(pts[i].y, zi2, t);
+    FP.mul(t, zs[j].data(), y);
+    outs[i][0] = 0;
+    fp_to_be48(x, outs[i] + 1);
+    fp_to_be48(y, outs[i] + 49);
+  }
+}
+
+static void g2_write_batch(const std::vector<G2>& pts,
+                           const std::vector<uint8_t*>& outs) {
+  int m = (int)pts.size();
+  std::vector<Fp2> zs;
+  std::vector<int> idx;
+  zs.reserve(m);
+  idx.reserve(m);
+  for (int i = 0; i < m; ++i) {
+    if (pts[i].inf) {
+      memset(outs[i], 0, 193);
+      outs[i][0] = 0x40;
+    } else {
+      zs.push_back(pts[i].z);
+      idx.push_back(i);
+    }
+  }
+  f2_batch_inv(zs);
+  for (size_t j = 0; j < idx.size(); ++j) {
+    int i = idx[j];
+    Fp2 zi2, x, y, t;
+    f2_sqr(zs[j], zi2);
+    f2_mul(pts[i].x, zi2, x);
+    f2_mul(pts[i].y, zi2, t);
+    f2_mul(t, zs[j], y);
+    outs[i][0] = 0;
+    fp_to_be48(x.a, outs[i] + 1);
+    fp_to_be48(x.b, outs[i] + 49);
+    fp_to_be48(y.a, outs[i] + 97);
+    fp_to_be48(y.b, outs[i] + 145);
+  }
+}
+
 static void fr_from_be32(const uint8_t* in, u64* raw4) {
   for (int i = 0; i < 4; ++i) {
     u64 limb = 0;
@@ -1884,40 +1989,58 @@ int bls_tpke_encrypt_batch(const uint8_t* pk97, const uint8_t* msgs,
   G1Win4 pk_tab;
   bool use_tab = count >= 64;  // build cost ~960 adds vs 63 adds/mul saved
   if (use_tab) pk_tab.build(pk);
-  const uint8_t* mp = msgs;
-  uint8_t* op = out;
-  for (int i = 0; i < count; ++i) {
-    int64_t len = lens[i];
-    u64 k[4], km[4], kr[4];
-    fr_from_be32(rs + 32 * i, k);
-    FR.from_raw(k, km);
-    FR.to_raw(km, kr);
-    G1 u, mask;
-    g1_mul_gen(kr, u);
-    if (use_tab)
-      pk_tab.mul(kr, mask);
-    else
-      g1_mul_glv(pk, kr, mask);
-    uint8_t* u_out = op;
-    uint8_t* w_out = op + 97;
-    uint8_t* v_out = op + 290;
-    g1_write(u, u_out);
-    uint8_t mask_bytes[97];
-    g1_write(mask, mask_bytes);
-    std::vector<uint8_t> stream(len);
-    kdf_stream(mask_bytes, len, stream.data());
-    for (int64_t j = 0; j < len; ++j) v_out[j] = mp[j] ^ stream[j];
-    std::vector<uint8_t> hin(10 + 97 + len);
-    memcpy(hin.data(), "HBBFT-TPKE", 10);
-    memcpy(hin.data() + 10, u_out, 97);
-    memcpy(hin.data() + 107, v_out, len);
-    G2 h, w;
-    hash_g2_point(hin.data(), (int64_t)hin.size(), h);
-    g2_mul_gls(h, kr, w);
-    g2_write(w, w_out);
-    mp += len;
-    op += 290 + len;
+  // pass 1: all U = g1^r and mask = pk^r ladders (Jacobian), then ONE
+  // shared inversion chain writes every affine point — per-item pow
+  // inversions were ~10 % of the batch
+  std::vector<std::array<u64, 4>> krs(count);
+  std::vector<G1> g1s(2 * count);
+  std::vector<uint8_t> maskb(97 * (size_t)count);
+  std::vector<uint8_t*> g1outs(2 * count);
+  {
+    uint8_t* op = out;
+    for (int i = 0; i < count; ++i) {
+      u64 k[4], km[4];
+      fr_from_be32(rs + 32 * i, k);
+      FR.from_raw(k, km);
+      FR.to_raw(km, krs[i].data());
+      g1_mul_gen(krs[i].data(), g1s[2 * i]);
+      if (use_tab)
+        pk_tab.mul(krs[i].data(), g1s[2 * i + 1]);
+      else
+        g1_mul_glv(pk, krs[i].data(), g1s[2 * i + 1]);
+      g1outs[2 * i] = op;                      // U straight into out
+      g1outs[2 * i + 1] = &maskb[97 * (size_t)i];
+      op += 290 + lens[i];
+    }
   }
+  g1_write_batch(g1s, g1outs);
+  // pass 2: V = msg ⊕ KDF(mask), W = hash_g2(U‖V)^r (Jacobian), then one
+  // shared Fp2 inversion chain writes the W points
+  std::vector<G2> ws(count);
+  std::vector<uint8_t*> wouts(count);
+  {
+    const uint8_t* mp = msgs;
+    uint8_t* op = out;
+    for (int i = 0; i < count; ++i) {
+      int64_t len = lens[i];
+      uint8_t* u_out = op;
+      uint8_t* v_out = op + 290;
+      std::vector<uint8_t> stream(len);
+      kdf_stream(&maskb[97 * (size_t)i], len, stream.data());
+      for (int64_t j = 0; j < len; ++j) v_out[j] = mp[j] ^ stream[j];
+      std::vector<uint8_t> hin(10 + 97 + len);
+      memcpy(hin.data(), "HBBFT-TPKE", 10);
+      memcpy(hin.data() + 10, u_out, 97);
+      memcpy(hin.data() + 107, v_out, len);
+      G2 h;
+      hash_g2_point(hin.data(), (int64_t)hin.size(), h);
+      g2_mul_gls(h, krs[i].data(), ws[i]);
+      wouts[i] = op + 97;
+      mp += len;
+      op += 290 + len;
+    }
+  }
+  g2_write_batch(ws, wouts);
   return 0;
 }
 
@@ -1974,17 +2097,22 @@ int bls_tpke_decrypt_batch(const uint8_t* s_be32, const uint8_t* us97,
   fr_from_be32(s_be32, k);
   FR.from_raw(k, km);
   FR.to_raw(km, kr);
+  std::vector<G1> masks(count);
+  for (int i = 0; i < count; ++i) {
+    G1 u;
+    if (!g1_read(us97 + 97 * i, u)) return -1;
+    g1_mul_glv(u, kr, masks[i]);
+  }
+  std::vector<uint8_t> maskb(97 * (size_t)count);
+  std::vector<uint8_t*> mouts(count);
+  for (int i = 0; i < count; ++i) mouts[i] = &maskb[97 * (size_t)i];
+  g1_write_batch(masks, mouts);
   const uint8_t* vp = vs;
   uint8_t* op = out;
   for (int i = 0; i < count; ++i) {
-    G1 u, m;
-    if (!g1_read(us97 + 97 * i, u)) return -1;
-    g1_mul_glv(u, kr, m);
-    uint8_t mask_bytes[97];
-    g1_write(m, mask_bytes);
     int64_t len = vlens[i];
     std::vector<uint8_t> stream(len);
-    kdf_stream(mask_bytes, len, stream.data());
+    kdf_stream(mouts[i], len, stream.data());
     for (int64_t j = 0; j < len; ++j) op[j] = vp[j] ^ stream[j];
     vp += len;
     op += len;
@@ -2011,26 +2139,35 @@ int bls_tpke_check_decrypt_batch(const uint8_t* s_be32,
   fr_from_be32(s_be32, k);
   FR.from_raw(k, km);
   FR.to_raw(km, kr);
+  std::vector<G1> masks(count);
+  {
+    const uint8_t* pp = payloads;
+    for (int i = 0; i < count; ++i) {
+      int64_t plen = plens[i];
+      if (plen < 294) return i + 1;
+      int64_t vlen = ((int64_t)pp[290] << 24) | ((int64_t)pp[291] << 16) |
+                     ((int64_t)pp[292] << 8) | (int64_t)pp[293];
+      if (vlen != plen - 294) return i + 1;
+      G1 u;
+      G2 w;
+      if (!g1_read_checked(pp, u)) return i + 1;
+      if (!g2_read_checked(pp + 97, w)) return i + 1;
+      g1_mul_glv(u, kr, masks[i]);
+      pp += plen;
+    }
+  }
+  std::vector<uint8_t> maskb(97 * (size_t)count);
+  std::vector<uint8_t*> mouts(count);
+  for (int i = 0; i < count; ++i) mouts[i] = &maskb[97 * (size_t)i];
+  g1_write_batch(masks, mouts);
   const uint8_t* pp = payloads;
   uint8_t* op = out;
   for (int i = 0; i < count; ++i) {
-    int64_t plen = plens[i];
-    if (plen < 294) return i + 1;
-    int64_t vlen = ((int64_t)pp[290] << 24) | ((int64_t)pp[291] << 16) |
-                   ((int64_t)pp[292] << 8) | (int64_t)pp[293];
-    if (vlen != plen - 294) return i + 1;
-    G1 u;
-    G2 w;
-    if (!g1_read_checked(pp, u)) return i + 1;
-    if (!g2_read_checked(pp + 97, w)) return i + 1;
-    G1 m;
-    g1_mul_glv(u, kr, m);
-    uint8_t mask_bytes[97];
-    g1_write(m, mask_bytes);
+    int64_t vlen = plens[i] - 294;
     std::vector<uint8_t> stream(vlen);
-    kdf_stream(mask_bytes, vlen, stream.data());
+    kdf_stream(mouts[i], vlen, stream.data());
     for (int64_t j = 0; j < vlen; ++j) op[j] = pp[294 + j] ^ stream[j];
-    pp += plen;
+    pp += plens[i];
     op += vlen;
   }
   return 0;

@@ -152,3 +152,77 @@ def test_batch_tpke_check_decrypt_fused(keys):
     ]
     assert BT.batch_tpke_check_decrypt(pks, mixed, shares) == expect
     assert expect[1] == msgs[2]  # the trailing byte is outside vlen
+
+
+def test_fused_decrypt_mutation_parity(keys):
+    """Property sweep of the crypto wire boundary: for randomly mutated
+    ciphertext payloads, the fused native path and the per-item Python
+    path must agree EXACTLY — same plaintexts when accepted, rejection
+    (ValueError) on the same inputs.  Guards the duplicated accept-set
+    logic (flag/canonical/on-curve/subgroup/framing) against drift."""
+    from hypothesis import HealthCheck, given, settings
+    from hypothesis import strategies as st
+
+    from hbbft_tpu.crypto import batch as BT
+    from hbbft_tpu.crypto import tc
+
+    rng, sks, pks = keys
+    pk = pks.public_key()
+    base = [
+        ct.to_bytes()
+        for ct in tc.tpke_encrypt_batch(
+            pk, [b"mut-%d" % i * (i + 1) for i in range(4)], rng
+        )
+    ]
+    shares = [(i, sks.secret_key_share(i)) for i in range(pks.threshold() + 1)]
+
+    def per_item(payloads):
+        cts = [tc.Ciphertext.from_bytes(p) for p in payloads]
+        return BT.batch_tpke_decrypt(pks, cts, shares)
+
+    @settings(
+        max_examples=25,
+        deadline=None,
+        suppress_health_check=[HealthCheck.too_slow],
+    )
+    @given(st.data())
+    def sweep(data):
+        payloads = []
+        for i, b in enumerate(base):
+            mode = data.draw(
+                st.sampled_from(["keep", "flip", "trunc", "vlen"]),
+                label=f"mode{i}",
+            )
+            p = bytearray(b)
+            if mode == "flip":
+                pos = data.draw(
+                    st.integers(0, len(p) - 1), label=f"pos{i}"
+                )
+                p[pos] ^= 1 << data.draw(
+                    st.integers(0, 7), label=f"bit{i}"
+                )
+            elif mode == "trunc":
+                cut = data.draw(
+                    st.integers(0, len(p) - 1), label=f"cut{i}"
+                )
+                p = p[:cut]
+            elif mode == "vlen":
+                delta = data.draw(
+                    st.integers(-3, 3), label=f"d{i}"
+                )
+                v = max(0, int.from_bytes(p[290:294], "big") + delta)
+                p[290:294] = v.to_bytes(4, "big")
+            payloads.append(bytes(p))
+
+        try:
+            want = per_item(payloads)
+            raised = None
+        except (ValueError, IndexError) as e:
+            want, raised = None, type(e)
+        if raised is None:
+            assert BT.batch_tpke_check_decrypt(pks, payloads, shares) == want
+        else:
+            with pytest.raises(raised):
+                BT.batch_tpke_check_decrypt(pks, payloads, shares)
+
+    sweep()

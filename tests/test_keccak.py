@@ -38,3 +38,18 @@ def test_round_constants_known_values():
     assert keccak.ROUND_CONSTANTS[0] == 0x0000000000000001
     assert keccak.ROUND_CONSTANTS[1] == 0x0000000000008082
     assert keccak.ROUND_CONSTANTS[23] == 0x8000000080008008
+
+
+@pytest.mark.parametrize("form", ["wide", "compact"])
+def test_both_round_forms_bit_exact(form, monkeypatch):
+    """Both traced round-body forms (the TPU-tuned unrolled one and the
+    compile-cheap compact one — see keccak._keccak_form) must be bit-exact
+    against hashlib regardless of which backend auto-selection would pick."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("HBBFT_KECCAK_FORM", form)
+    rng = np.random.RandomState(7)
+    data = rng.randint(0, 256, (4, 77)).astype(np.uint8)
+    out = np.asarray(keccak.sha3_256(jnp.asarray(data)))
+    for i in range(4):
+        assert out[i].tobytes() == hashlib.sha3_256(data[i].tobytes()).digest()

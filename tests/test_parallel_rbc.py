@@ -358,7 +358,9 @@ def test_large_n_compact_transfers_bit_equal():
     kb = rbc.k * 2  # shard_len resolves to 2 for small payloads
     values = [bytes([p % 251 + 1]) * (1 + (p * 37) % 60) for p in range(n)]
     values[0] = b""                      # empty value
-    values[1] = bytes(range(256)) * ((kb - 4) // 256)  # near-full frame
+    # a value filling the whole frame: fetch window must reach k*B exactly
+    values[1] = (bytes(range(256)) * (kb // 256 + 1))[: kb - 4]
+    assert len(values[1]) == kb - 4
     # compact upload == naive frame, byte for byte
     np.testing.assert_array_equal(
         np.asarray(rbc.upload_framed(values)), frame_values(values, rbc.k)
