@@ -1285,6 +1285,18 @@ static void g1_write_batch(const std::vector<G1>& pts,
   }
 }
 
+// Affine-write m G1 points into one contiguous 97-byte-stride buffer with a
+// shared inversion chain (the mask-serialization step of both batch decrypt
+// entry points).
+static std::vector<uint8_t> g1_write_contig(const std::vector<G1>& pts) {
+  int m = (int)pts.size();
+  std::vector<uint8_t> buf(97 * (size_t)m);
+  std::vector<uint8_t*> outs(m);
+  for (int i = 0; i < m; ++i) outs[i] = &buf[97 * (size_t)i];
+  g1_write_batch(pts, outs);
+  return buf;
+}
+
 static void g2_write_batch(const std::vector<G2>& pts,
                            const std::vector<uint8_t*>& outs) {
   int m = (int)pts.size();
@@ -2103,16 +2115,13 @@ int bls_tpke_decrypt_batch(const uint8_t* s_be32, const uint8_t* us97,
     if (!g1_read(us97 + 97 * i, u)) return -1;
     g1_mul_glv(u, kr, masks[i]);
   }
-  std::vector<uint8_t> maskb(97 * (size_t)count);
-  std::vector<uint8_t*> mouts(count);
-  for (int i = 0; i < count; ++i) mouts[i] = &maskb[97 * (size_t)i];
-  g1_write_batch(masks, mouts);
+  std::vector<uint8_t> maskb = g1_write_contig(masks);
   const uint8_t* vp = vs;
   uint8_t* op = out;
   for (int i = 0; i < count; ++i) {
     int64_t len = vlens[i];
     std::vector<uint8_t> stream(len);
-    kdf_stream(mouts[i], len, stream.data());
+    kdf_stream(&maskb[97 * (size_t)i], len, stream.data());
     for (int64_t j = 0; j < len; ++j) op[j] = vp[j] ^ stream[j];
     vp += len;
     op += len;
@@ -2156,16 +2165,13 @@ int bls_tpke_check_decrypt_batch(const uint8_t* s_be32,
       pp += plen;
     }
   }
-  std::vector<uint8_t> maskb(97 * (size_t)count);
-  std::vector<uint8_t*> mouts(count);
-  for (int i = 0; i < count; ++i) mouts[i] = &maskb[97 * (size_t)i];
-  g1_write_batch(masks, mouts);
+  std::vector<uint8_t> maskb = g1_write_contig(masks);
   const uint8_t* pp = payloads;
   uint8_t* op = out;
   for (int i = 0; i < count; ++i) {
     int64_t vlen = plens[i] - 294;
     std::vector<uint8_t> stream(vlen);
-    kdf_stream(mouts[i], vlen, stream.data());
+    kdf_stream(&maskb[97 * (size_t)i], vlen, stream.data());
     for (int64_t j = 0; j < vlen; ++j) op[j] = pp[294 + j] ^ stream[j];
     pp += plens[i];
     op += vlen;
