@@ -26,6 +26,31 @@ from hbbft_tpu.protocols.queueing_honey_badger import (
 )
 
 
+def _commit_txs(pairs, seen, committed, queues, lock=None):
+    """Shared ledger-commit step of the queueing drivers: dedup one epoch's
+    (proposer, serialized-txs) pairs in deterministic proposer order, prune
+    every queue with ONE drop set (the O(N²)-hash fix), record the network
+    commit order.  Returns the newly committed transactions."""
+    new: List[bytes] = []
+    epoch_txs: List[bytes] = []
+    for _nid, payload in sorted(pairs, key=lambda kv: repr(kv[0])):
+        for tx in _de_txs(payload):
+            epoch_txs.append(tx)
+            if tx not in seen:
+                seen.add(tx)
+                new.append(tx)
+    drop = frozenset(epoch_txs)
+    if lock is not None:
+        with lock:
+            for q in queues.values():
+                q.remove_multiple(drop)
+    else:
+        for q in queues.values():
+            q.remove_multiple(drop)
+    committed.extend(new)
+    return new
+
+
 class BatchedQueueingHoneyBadger:
     """Epoch driver: queues + batched epochs until the ledger drains."""
 
@@ -93,19 +118,10 @@ class BatchedQueueingHoneyBadger:
 
     def _commit(self, batch) -> List[bytes]:
         """Dedup + queue-prune one epoch's agreed batch (host)."""
-        new: List[bytes] = []
-        epoch_txs: List[bytes] = []
-        for nid in sorted(batch.keys(), key=repr):
-            for tx in _de_txs(batch[nid]):
-                epoch_txs.append(tx)
-                if tx not in self._seen:
-                    self._seen.add(tx)
-                    new.append(tx)
-        drop = frozenset(epoch_txs)
-        with self._queue_lock:
-            for q in self.queues.values():
-                q.remove_multiple(drop)
-        self.committed.extend(new)
+        new = _commit_txs(
+            batch.items(), self._seen, self.committed, self.queues,
+            lock=self._queue_lock,
+        )
         self.epoch += 1
         return new
 
@@ -160,3 +176,76 @@ class BatchedQueueingHoneyBadger:
                 if on_epoch is not None:
                     on_epoch(self.epoch, new)
         return total_new
+
+
+class BatchedQueueingDynamicHoneyBadger:
+    """The reference's top-of-stack composition in array mode:
+    ``QueueingHoneyBadger`` wraps ``DynamicHoneyBadger`` (reference:
+    ``src/queueing_honey_badger/`` over ``src/dynamic_honey_badger/``), so
+    transaction queueing and on-line membership changes run TOGETHER.  Here
+    the per-node queues feed a :class:`~hbbft_tpu.parallel.dhb.
+    BatchedDynamicHoneyBadger`: each epoch samples ``batch_size``
+    transactions per validator, runs them (plus pending votes and DKG
+    messages) through one batched HoneyBadger epoch, commits new
+    transactions exactly once, and prunes every queue.  Era rotations are
+    transparent to the ledger: queues persist across eras, a removed
+    validator simply stops proposing, an added one starts.
+    """
+
+    def __init__(self, netinfo_map: Dict, batch_size: int = 100,
+                 session_id: bytes = b"batched-qdhb", rng=None):
+        from hbbft_tpu.parallel.dhb import BatchedDynamicHoneyBadger
+
+        self.dhb = BatchedDynamicHoneyBadger(
+            netinfo_map, session_id=session_id, rng=rng
+        )
+        self.batch_size = batch_size
+        self.queues = {nid: TransactionQueue() for nid in self.dhb.validators}
+        self.committed: List[bytes] = []
+        self._seen = set()
+
+    # -- transaction + vote inputs ------------------------------------------
+
+    def push(self, node_id, tx: bytes) -> None:
+        self.queues.setdefault(node_id, TransactionQueue()).extend([tx])
+
+    def pending(self) -> int:
+        return sum(
+            len(self.queues.get(nid, ())) for nid in self.dhb.validators
+        )
+
+    def vote_to_add(self, voter, node_id, pub_key, secret_key=None) -> None:
+        self.dhb.vote_to_add(voter, node_id, pub_key, secret_key=secret_key)
+
+    def vote_to_remove(self, voter, node_id) -> None:
+        self.dhb.vote_to_remove(voter, node_id)
+
+    def vote_for_encryption_schedule(self, voter, schedule) -> None:
+        self.dhb.vote_for_encryption_schedule(voter, schedule)
+
+    # -- the epoch loop ------------------------------------------------------
+
+    def run_epoch(self, rng) -> List[bytes]:
+        """Sample proposals from the CURRENT validator set's queues, run one
+        dynamic epoch (votes/DKG ride along), commit + prune.  Returns the
+        newly committed transactions."""
+        contribs = {}
+        for nid in self.dhb.validators:
+            q = self.queues.setdefault(nid, TransactionQueue())
+            contribs[nid] = _ser_txs(q.choose(rng, self.batch_size))
+        batch = self.dhb.run_epoch(contribs, rng)
+        return _commit_txs(
+            batch.contributions, self._seen, self.committed, self.queues,
+        )
+
+    def run_to_empty(self, rng, max_epochs: int = 64) -> int:
+        """Epochs until every transaction in a CURRENT validator's queue
+        committed (queues of non-validators don't count — a removed node
+        cannot propose)."""
+        epochs = 0
+        while self.pending() > 0:
+            if epochs >= max_epochs:
+                raise RuntimeError("transactions not drained")
+            self.run_epoch(rng)
+            epochs += 1
+        return epochs

@@ -200,3 +200,43 @@ def test_cross_mode_remove_matches_object_network():
     assert (0, 0) in common
     for key in common:
         assert obj_map[key] == arr_map[key], key
+
+
+def test_queueing_over_dynamic_membership():
+    """The composed top-of-stack driver: transactions drain across an era
+    boundary while a validator is voted out mid-run; every tx in a
+    remaining validator's queue commits exactly once."""
+    from hbbft_tpu.parallel.qhb import BatchedQueueingDynamicHoneyBadger
+
+    infos = NetworkInfo.generate_map(list(range(4)), random.Random(21))
+    q = BatchedQueueingDynamicHoneyBadger(
+        infos, batch_size=3, session_id=b"qdhb-t", rng=random.Random(9)
+    )
+    rng = random.Random(5)
+    keepers_txs = set()
+    for nid in range(4):
+        for j in range(5):
+            tx = b"tx|%d|%d|%d" % (nid, j, rng.getrandbits(32))
+            q.push(nid, tx)
+            if nid != 3:
+                keepers_txs.add(tx)
+    # one normal epoch, then vote node 3 out and keep draining
+    q.run_epoch(random.Random(50))
+    for voter in range(4):
+        q.vote_to_remove(voter, 3)
+    for e in range(12):
+        q.run_epoch(random.Random(60 + e))
+        if q.dhb.era == 1 and q.pending() == 0:
+            break
+    assert q.dhb.era == 1
+    assert sorted(q.dhb.validators) == [0, 1, 2]
+    assert q.pending() == 0
+    # every keeper tx committed exactly once; era-0 proposals from node 3
+    # may have committed before its removal, never after
+    committed = set(q.committed)
+    assert keepers_txs <= committed
+    assert len(q.committed) == len(committed)
+    # the ledger keeps working in era 1
+    q.push(0, b"era1-tx")
+    q.run_epoch(random.Random(99))
+    assert b"era1-tx" in q.committed
