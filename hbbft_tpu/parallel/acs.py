@@ -36,9 +36,32 @@ class BatchedAcs:
         self.mesh = mesh
         self.rbc = BatchedRbc(n, f)
         self.aba = BatchedAba(n, f)
+        self._build_runners()
+
+    def __getstate__(self):
+        """Snapshot support: jit handles rebuild on restore.  Mesh-sharded
+        instances refuse to pickle — a ``Mesh`` is bound to live devices;
+        snapshot the unsharded driver and re-attach the mesh on restore."""
+        if self.mesh is not None:
+            raise TypeError(
+                "cannot snapshot a mesh-sharded BatchedAcs; snapshot the "
+                "mesh=None driver and reconstruct the sharded one from it"
+            )
+        d = self.__dict__.copy()
+        d.pop("_rbc_run", None)
+        d.pop("_aba_step", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._build_runners()
+
+    def _build_runners(self):
         # jit once per instance — a fresh jax.jit per run() call would
         # recompile the whole pipeline every epoch
         import jax
+
+        mesh, n = self.mesh, self.n
 
         if mesh is not None:
             # the whole epoch rides the device mesh: RBC fan-out and ABA

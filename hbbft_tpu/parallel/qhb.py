@@ -40,11 +40,9 @@ def _commit_txs(pairs, seen, committed, queues, lock=None):
                 seen.add(tx)
                 new.append(tx)
     drop = frozenset(epoch_txs)
-    if lock is not None:
-        with lock:
-            for q in queues.values():
-                q.remove_multiple(drop)
-    else:
+    import contextlib
+
+    with lock if lock is not None else contextlib.nullcontext():
         for q in queues.values():
             q.remove_multiple(drop)
     committed.extend(new)
@@ -72,6 +70,17 @@ class BatchedQueueingHoneyBadger:
         self.committed: List[bytes] = []  # network commit order, once each
         self._seen = set()
         self.epoch = 0
+
+    # -- pickling (snapshot/restore support) --------------------------------
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d["_queue_lock"] = None  # locks don't pickle; recreated on restore
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._queue_lock = threading.Lock()
 
     def push(self, node_id, tx: bytes) -> None:
         """Inject a transaction at one node (``Input::User`` analog)."""

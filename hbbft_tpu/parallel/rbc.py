@@ -347,6 +347,16 @@ class BatchedRbc:
         }
 
 
+    # -- pickling (snapshot/restore support) --------------------------------
+
+    def __getstate__(self):
+        """Drop jit handles and device-resident constants — they rebuild
+        lazily after :func:`hbbft_tpu.snapshot.restore`."""
+        d = self.__dict__.copy()
+        d["_jit_cache"] = {}
+        d.pop("_pbits_dev", None)
+        return d
+
     # ------------------------------------------------------------- large N
     def _jit(self, name, fn):
         if name not in self._jit_cache:
