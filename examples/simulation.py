@@ -46,13 +46,28 @@ def main() -> None:
              "HoneyBadger epoch per round) instead of the object-mode "
              "message pump",
     )
+    ap.add_argument(
+        "--remove-node", type=int, metavar="ID", default=None,
+        help="with --batched: vote node ID out mid-run — the ledger drains "
+             "across the DKG + era rotation (the composed "
+             "queueing-over-dynamic-membership stack)",
+    )
     args = ap.parse_args()
 
     n = args.nodes
+    # arg validation BEFORE the expensive BLS keygen
+    if args.remove_node is not None and not args.batched:
+        ap.error("--remove-node requires --batched")
+    if args.remove_node is not None and not 0 <= args.remove_node < n:
+        ap.error(f"--remove-node {args.remove_node} is not a validator id "
+                 f"(0..{n - 1})")
     rng = random.Random(args.seed)
     print(f"generating BLS keys for {n} nodes…")
     infos = NetworkInfo.generate_map(list(range(n)), rng)
 
+    if args.remove_node is not None:
+        run_batched_dynamic(args, infos, rng)
+        return
     if args.batched:
         run_batched(args, infos, rng)
         return
@@ -174,6 +189,66 @@ def run_batched(args, infos, rng) -> None:
           f"({len(qhb.committed) / max(wall, 1e-9):.0f} tx/s incl. compile)")
     print(f"virtual time {qhb.virtual_time * 1e3:.3f} ms "
           f"({len(qhb.committed) / max(qhb.virtual_time, 1e-12):.0f} "
+          f"tx/s simulated)")
+
+
+def run_batched_dynamic(args, infos, rng) -> None:
+    """The composed stack: transaction queueing over dynamic membership —
+    vote ``--remove-node`` out after the first epoch and drain the ledger
+    across the DKG + era rotation."""
+    from hbbft_tpu.parallel.qhb import BatchedQueueingDynamicHoneyBadger
+
+    n = args.nodes
+    victim = args.remove_node
+    if victim not in infos:
+        raise SystemExit(f"--remove-node {victim} is not a validator id")
+    cost = CostModel(
+        bandwidth_bps=args.bandwidth_gbps * 1e9,
+        cpu_lag_s=args.cpu_lag_us * 1e-6,
+    )
+    q = BatchedQueueingDynamicHoneyBadger(
+        infos, batch_size=args.batch_size, rng=random.Random(args.seed + 1),
+        cost_model=cost,
+    )
+    txs = [
+        bytes(rng.randrange(256) for _ in range(args.tx_size))
+        for _ in range(args.txs)
+    ]
+    keepers = [nid for nid in range(n) if nid != victim]
+    for i, tx in enumerate(txs):
+        q.push(keepers[i % len(keepers)], tx)
+
+    print(f"\n{'era.ep':>7} {'txs':>6} {'total':>6} {'validators':>11} "
+          f"{'change':>12} {'wall(s)':>9}")
+    t0 = time.perf_counter()
+    last = t0
+    epochs = 0
+    max_epochs = max(
+        64, 4 * -(-args.txs // max(n * args.batch_size, 1)) + 8
+    )
+    while q.pending() > 0 or q.dhb.era == 0:
+        if epochs >= max_epochs:
+            raise SystemExit("did not drain")
+        if epochs == 1:
+            for voter in list(q.dhb.validators):
+                q.vote_to_remove(voter, victim)
+            print(f"# epoch 1: all validators vote to remove {victim}")
+        new = q.run_epoch(random.Random(3000 + epochs))
+        b = q.dhb.batches[-1]
+        now = time.perf_counter()
+        print(f"{b.era:>4}.{b.epoch:<2} {len(new):>6} {len(q.committed):>6} "
+              f"{len(q.dhb.validators):>11} {b.change.state:>12} "
+              f"{now - last:>9.2f}")
+        last = now
+        epochs += 1
+    wall = time.perf_counter() - t0
+    assert set(q.committed) == set(txs)
+    assert q.dhb.era >= 1 and victim not in q.dhb.validators
+    print(f"\ncommitted {len(q.committed)}/{len(txs)} txs across the era "
+          f"rotation in {epochs} epochs; era {q.dhb.era}, validators "
+          f"{sorted(q.dhb.validators)}; wall {wall:.2f}s")
+    print(f"virtual time {q.virtual_time * 1e3:.3f} ms "
+          f"({len(q.committed) / max(q.virtual_time, 1e-12):.0f} "
           f"tx/s simulated)")
 
 

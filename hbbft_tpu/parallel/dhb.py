@@ -169,10 +169,15 @@ class BatchedDynamicHoneyBadger:
                 key_gen_msgs=kg_msgs,
             )
             internal[nid] = contrib.to_bytes()
-        batch_map, _detail = self.hb.run(
+        batch_map, detail = self.hb.run(
             internal, rng, session_suffix=b"/e" + wire.u64(self.epoch),
             encrypt=self.encryption_schedule.encrypt_on_epoch(self.epoch),
         )
+        # what wrappers need for cost accounting (the QDHB virtual clock)
+        self.last_detail = {
+            "payload_bytes": int(detail["payload_bytes"]),
+            "epochs": int(detail["epochs"]),
+        }
         return self._process_batch(batch_map)
 
     def run_until_change_completes(self, contribution_fn=None,

@@ -202,7 +202,8 @@ class BatchedQueueingDynamicHoneyBadger:
     """
 
     def __init__(self, netinfo_map: Dict, batch_size: int = 100,
-                 session_id: bytes = b"batched-qdhb", rng=None):
+                 session_id: bytes = b"batched-qdhb", rng=None,
+                 cost_model=None):
         from hbbft_tpu.parallel.dhb import BatchedDynamicHoneyBadger
 
         self.dhb = BatchedDynamicHoneyBadger(
@@ -212,6 +213,8 @@ class BatchedQueueingDynamicHoneyBadger:
         self.queues = {nid: TransactionQueue() for nid in self.dhb.validators}
         self.committed: List[bytes] = []
         self._seen = set()
+        self.cost_model = cost_model  # optional sim.CostModel → virtual clock
+        self.virtual_time = 0.0
 
     # -- transaction + vote inputs ------------------------------------------
 
@@ -243,6 +246,13 @@ class BatchedQueueingDynamicHoneyBadger:
             q = self.queues.setdefault(nid, TransactionQueue())
             contribs[nid] = _ser_txs(q.choose(rng, self.batch_size))
         batch = self.dhb.run_epoch(contribs, rng)
+        if self.cost_model is not None:
+            n = len(self.dhb.validators)
+            self.virtual_time += self.cost_model.batched_epoch_estimate(
+                n, (n - 1) // 3,
+                self.dhb.last_detail["payload_bytes"],
+                self.dhb.last_detail["epochs"],
+            )
         return _commit_txs(
             batch.contributions, self._seen, self.committed, self.queues,
         )
