@@ -31,6 +31,25 @@ from hbbft_tpu.protocols.queueing_honey_badger import (
 from hbbft_tpu.sim import CostModel, EventLog, NetBuilder, NullAdversary
 
 
+def make_cost_model(args) -> CostModel:
+    return CostModel(
+        bandwidth_bps=args.bandwidth_gbps * 1e9,
+        cpu_lag_s=args.cpu_lag_us * 1e-6,
+    )
+
+
+def gen_txs(args, rng):
+    return [
+        bytes(rng.randrange(256) for _ in range(args.tx_size))
+        for _ in range(args.txs)
+    ]
+
+
+def print_virtual_time(committed: int, virtual_time: float) -> None:
+    print(f"virtual time {virtual_time * 1e3:.3f} ms "
+          f"({committed / max(virtual_time, 1e-12):.0f} tx/s simulated)")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--nodes", type=int, default=4)
@@ -56,11 +75,15 @@ def main() -> None:
 
     n = args.nodes
     # arg validation BEFORE the expensive BLS keygen
-    if args.remove_node is not None and not args.batched:
-        ap.error("--remove-node requires --batched")
-    if args.remove_node is not None and not 0 <= args.remove_node < n:
-        ap.error(f"--remove-node {args.remove_node} is not a validator id "
-                 f"(0..{n - 1})")
+    if args.remove_node is not None:
+        if not args.batched:
+            ap.error("--remove-node requires --batched")
+        if not 0 <= args.remove_node < n:
+            ap.error(f"--remove-node {args.remove_node} is not a validator "
+                     f"id (0..{n - 1})")
+        if n < 2:
+            ap.error("--remove-node needs at least 2 nodes (someone must "
+                     "remain to carry the ledger)")
     rng = random.Random(args.seed)
     print(f"generating BLS keys for {n} nodes…")
     infos = NetworkInfo.generate_map(list(range(n)), rng)
@@ -73,10 +96,7 @@ def main() -> None:
         return
 
     trace = EventLog()
-    cost = CostModel(
-        bandwidth_bps=args.bandwidth_gbps * 1e9,
-        cpu_lag_s=args.cpu_lag_us * 1e-6,
-    )
+    cost = make_cost_model(args)
     net = (
         NetBuilder(list(range(n)))
         .adversary(NullAdversary())
@@ -94,10 +114,7 @@ def main() -> None:
         )
     )
 
-    txs = [
-        bytes(rng.randrange(256) for _ in range(args.tx_size))
-        for _ in range(args.txs)
-    ]
+    txs = gen_txs(args, rng)
     for i, tx in enumerate(txs):
         net.send_input(i % n, TxInput(tx))
 
@@ -155,17 +172,10 @@ def run_batched(args, infos, rng) -> None:
     from hbbft_tpu.parallel.qhb import BatchedQueueingHoneyBadger
 
     n = args.nodes
-    cost = CostModel(
-        bandwidth_bps=args.bandwidth_gbps * 1e9,
-        cpu_lag_s=args.cpu_lag_us * 1e-6,
-    )
     qhb = BatchedQueueingHoneyBadger(
-        infos, batch_size=args.batch_size, cost_model=cost
+        infos, batch_size=args.batch_size, cost_model=make_cost_model(args)
     )
-    txs = [
-        bytes(rng.randrange(256) for _ in range(args.tx_size))
-        for _ in range(args.txs)
-    ]
+    txs = gen_txs(args, rng)
     for i, tx in enumerate(txs):
         qhb.push(i % n, tx)
 
@@ -187,9 +197,7 @@ def run_batched(args, infos, rng) -> None:
     print(f"\ncommitted {len(qhb.committed)}/{len(txs)} txs in "
           f"{qhb.epoch} batched epochs; wall {wall:.2f}s "
           f"({len(qhb.committed) / max(wall, 1e-9):.0f} tx/s incl. compile)")
-    print(f"virtual time {qhb.virtual_time * 1e3:.3f} ms "
-          f"({len(qhb.committed) / max(qhb.virtual_time, 1e-12):.0f} "
-          f"tx/s simulated)")
+    print_virtual_time(len(qhb.committed), qhb.virtual_time)
 
 
 def run_batched_dynamic(args, infos, rng) -> None:
@@ -199,21 +207,12 @@ def run_batched_dynamic(args, infos, rng) -> None:
     from hbbft_tpu.parallel.qhb import BatchedQueueingDynamicHoneyBadger
 
     n = args.nodes
-    victim = args.remove_node
-    if victim not in infos:
-        raise SystemExit(f"--remove-node {victim} is not a validator id")
-    cost = CostModel(
-        bandwidth_bps=args.bandwidth_gbps * 1e9,
-        cpu_lag_s=args.cpu_lag_us * 1e-6,
-    )
+    victim = args.remove_node  # validated against 0..n-1 at arg parsing
     q = BatchedQueueingDynamicHoneyBadger(
         infos, batch_size=args.batch_size, rng=random.Random(args.seed + 1),
-        cost_model=cost,
+        cost_model=make_cost_model(args),
     )
-    txs = [
-        bytes(rng.randrange(256) for _ in range(args.tx_size))
-        for _ in range(args.txs)
-    ]
+    txs = gen_txs(args, rng)
     keepers = [nid for nid in range(n) if nid != victim]
     for i, tx in enumerate(txs):
         q.push(keepers[i % len(keepers)], tx)
@@ -247,9 +246,7 @@ def run_batched_dynamic(args, infos, rng) -> None:
     print(f"\ncommitted {len(q.committed)}/{len(txs)} txs across the era "
           f"rotation in {epochs} epochs; era {q.dhb.era}, validators "
           f"{sorted(q.dhb.validators)}; wall {wall:.2f}s")
-    print(f"virtual time {q.virtual_time * 1e3:.3f} ms "
-          f"({len(q.committed) / max(q.virtual_time, 1e-12):.0f} "
-          f"tx/s simulated)")
+    print_virtual_time(len(q.committed), q.virtual_time)
 
 
 if __name__ == "__main__":

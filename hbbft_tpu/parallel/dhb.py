@@ -77,7 +77,9 @@ class BatchedDynamicHoneyBadger:
         secret_keys: Optional[Dict] = None,
         session_id: bytes = b"batched-dhb",
         rng: Optional[random.Random] = None,
+        mesh=None,
     ):
+        self.mesh = mesh
         self.netinfo_map = dict(netinfo_map)
         ids = sorted(self.netinfo_map.keys(), key=repr)
         self.secret_keys = dict(secret_keys) if secret_keys else {
@@ -103,13 +105,34 @@ class BatchedDynamicHoneyBadger:
         self.batches: List[DhbBatch] = []
         self.hb = self._make_hb()
 
+    # -- pickling (snapshot/restore support) --------------------------------
+
+    def __getstate__(self):
+        """A live ``Mesh`` binds devices and cannot round-trip a pickle;
+        refuse here like ``BatchedAcs`` does (the inner epoch's own guard
+        no longer fires when the current era fell back to single-device)."""
+        if self.mesh is not None:
+            raise TypeError(
+                "cannot snapshot a mesh-attached BatchedDynamicHoneyBadger; "
+                "snapshot the mesh=None driver and re-attach the mesh after "
+                "restore"
+            )
+        return self.__dict__.copy()
+
     # -- construction of the per-era inner epoch runner ---------------------
 
     def _make_hb(self) -> BatchedHoneyBadgerEpoch:
+        # era rotation can change N to something the mesh no longer divides
+        # (the sharded epoch needs n % devices == 0); fall back to the
+        # single-device path for such eras rather than refusing the change
+        mesh = self.mesh
+        if mesh is not None and len(self.netinfo_map) % mesh.devices.size:
+            mesh = None
         return BatchedHoneyBadgerEpoch(
             self.netinfo_map,
             session_id=self.session_id + b"/era" + wire.u64(self.era),
             compact=True,
+            mesh=mesh,
         )
 
     @property
@@ -173,10 +196,14 @@ class BatchedDynamicHoneyBadger:
             internal, rng, session_suffix=b"/e" + wire.u64(self.epoch),
             encrypt=self.encryption_schedule.encrypt_on_epoch(self.epoch),
         )
-        # what wrappers need for cost accounting (the QDHB virtual clock)
+        # what wrappers need for cost accounting (the QDHB virtual clock):
+        # n/f of the era that RAN this epoch — _process_batch may rotate
+        # the era before control returns to the caller
         self.last_detail = {
             "payload_bytes": int(detail["payload_bytes"]),
             "epochs": int(detail["epochs"]),
+            "n": self.hb.n,
+            "f": self.hb.f,
         }
         return self._process_batch(batch_map)
 

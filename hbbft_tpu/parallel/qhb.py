@@ -247,11 +247,9 @@ class BatchedQueueingDynamicHoneyBadger:
             contribs[nid] = _ser_txs(q.choose(rng, self.batch_size))
         batch = self.dhb.run_epoch(contribs, rng)
         if self.cost_model is not None:
-            n = len(self.dhb.validators)
+            d = self.dhb.last_detail  # n/f of the era that ran the epoch
             self.virtual_time += self.cost_model.batched_epoch_estimate(
-                n, (n - 1) // 3,
-                self.dhb.last_detail["payload_bytes"],
-                self.dhb.last_detail["epochs"],
+                d["n"], d["f"], d["payload_bytes"], d["epochs"],
             )
         return _commit_txs(
             batch.contributions, self._seen, self.committed, self.queues,

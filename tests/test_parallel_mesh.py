@@ -339,3 +339,37 @@ def test_sharded_large_full_hb_epoch_matches_single_device(mesh8):
 
     assert batch_m == batch_s == contribs
     assert out_m["epochs"] == out_s["epochs"]
+
+
+@pytest.mark.slow  # a sharded-epoch compile + an N=8→9 DKG (~9 min on CPU)
+def test_dynamic_membership_on_the_mesh(mesh8):
+    """The dynamic driver rides the mesh: era 0 (N=8, sharded) votes a
+    node in; era 1 (N=9, which 8 devices no longer divide) falls back to
+    the single-device path — the documented rotation behavior — and the
+    ledger of batches stays correct throughout."""
+    import random
+
+    from hbbft_tpu.crypto import tc
+    from hbbft_tpu.netinfo import NetworkInfo
+    from hbbft_tpu.parallel.dhb import BatchedDynamicHoneyBadger
+
+    infos = NetworkInfo.generate_map(list(range(8)), random.Random(77))
+    dhb = BatchedDynamicHoneyBadger(
+        infos, session_id=b"mesh-dhb", rng=random.Random(5), mesh=mesh8
+    )
+    assert dhb.hb.acs.mesh is mesh8  # era 0 runs sharded
+    b0 = dhb.run_epoch({nid: b"m0-%d" % nid for nid in dhb.validators})
+    assert dict(b0.contributions) == {
+        nid: b"m0-%d" % nid for nid in range(8)
+    }
+    new_sk = tc.SecretKey.random(random.Random(6))
+    for voter in range(8):
+        dhb.vote_to_add(voter, 8, new_sk.public_key(), secret_key=new_sk)
+    dhb.run_epoch({nid: b"" for nid in dhb.validators})
+    dhb.run_until_change_completes()
+    assert dhb.era == 1 and sorted(dhb.validators) == list(range(9))
+    assert dhb.hb.acs.mesh is None  # 9 % 8 != 0 → single-device fallback
+    b1 = dhb.run_epoch({nid: b"m1-%d" % nid for nid in dhb.validators})
+    assert dict(b1.contributions) == {
+        nid: b"m1-%d" % nid for nid in range(9)
+    }
