@@ -240,3 +240,39 @@ def test_queueing_over_dynamic_membership():
     q.push(0, b"era1-tx")
     q.run_epoch(random.Random(99))
     assert b"era1-tx" in q.committed
+
+
+def test_vote_majority_property():
+    """Hypothesis sweep of the vote rule on the array driver: a change wins
+    (and the era rotates) iff a STRICT majority of current validators
+    committed a vote for it — the ``votes.rs`` rule."""
+    pytest.importorskip("hypothesis")
+    from hypothesis import HealthCheck, given, settings
+    from hypothesis import strategies as st
+
+    n = 4
+    infos = NetworkInfo.generate_map(list(range(n)), random.Random(61))
+
+    @settings(
+        max_examples=6, deadline=None,
+        suppress_health_check=[HealthCheck.too_slow],
+    )
+    @given(voters=st.sets(st.integers(0, n - 1)))
+    def sweep(voters):
+        dhb = BatchedDynamicHoneyBadger(
+            infos, session_id=b"prop-%d" % len(voters),
+            rng=random.Random(7),
+        )
+        for v in sorted(voters):
+            dhb.vote_to_remove(v, 3)
+        b0 = dhb.run_epoch({nid: b"x" for nid in dhb.validators})
+        if 2 * len(voters) > n:  # strict majority: the DKG starts
+            assert b0.change.state in ("in_progress", "complete")
+            if b0.change.state != "complete":
+                dhb.run_until_change_completes()
+            assert dhb.era == 1 and sorted(dhb.validators) == [0, 1, 2]
+        else:
+            assert b0.change.state == "none"
+            assert dhb.change_state.state == "none" and dhb.era == 0
+
+    sweep()
