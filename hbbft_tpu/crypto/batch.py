@@ -241,48 +241,94 @@ class _MsmCache:
     def msm_g2(self, points, scalars):
         return self._msm("g2", points, scalars)
 
-    def g1_mul_batch(self, points, scalars):
-        """Batched G1 scalar-mul for FULL-RANGE (mod r) scalars via GLV.
+    def _mul_batch_dispatch(self, group: str, points, scalars, endo, lam):
+        """Enqueue ONE endomorphism-split ladder for full-range (mod r)
+        scalars, returning a handle for :meth:`_mul_batch_collect`.
 
         The lazy ladder is sound only below 2^128 (see ops/fp381.py), so
-        each scalar splits against the curve endomorphism: s = a + b·λ
-        with a = s mod λ, b = s ÷ λ — both positive and < 2^128
-        (``bls12_381.LAMBDA_G1``) — and s·P = a·P + b·φ(P) where
-        φ costs one field mul per point.  ONE 128-bit ladder launch over
-        the doubled batch [P…, φ(P)…] replaces a 255-bit ladder; the final
-        a·P + b·φ(P) add runs on the host (complete addition — the two
+        each scalar splits against the group's endomorphism eigenvalue
+        ``lam``: s = a + b·λ with a = s mod λ, b = s ÷ λ — both positive
+        and < 2^128 — and s·P = a·P + b·endo(P), where ``endo`` costs one
+        or two host field muls per point (G1: GLV φ via β·x,
+        ``bls12_381.LAMBDA_G1``; G2: GLS ψ² via Fp coordinate norms,
+        ``bls12_381.LAMBDA_G2``).  ONE 128-bit ladder launch over the
+        doubled batch [P…, endo(P)…] replaces a 255-bit ladder; the final
+        a·P + b·endo(P) add runs on the host (complete addition — the two
         terms can collide as ±Q only on an algebraic coincidence).
-        Returns host Jacobian points (None = infinity), index-aligned.
-        """
+
+        The dispatch/collect split is what the split device encrypt's
+        chunk pipeline rides: G2 ladders of chunk i run on the device
+        while the host hashes chunk i+1."""
         import jax.numpy as jnp
 
         B = len(points)
         size = self._pad(B)
-        fn, rep = self._get("g1", 2 * size)
+        fn, rep = self._get(group, 2 * size)
         pts = list(points) + [None] * (size - B)
         sc = [s % c.R for s in scalars] + [0] * (size - B)
-        a = [s % c.LAMBDA_G1 for s in sc]
-        b = [s // c.LAMBDA_G1 for s in sc]
-        phi = [c.g1_endo(p) for p in pts]
+        a = [s % lam for s in sc]
+        b = [s // lam for s in sc]
+        phi = [endo(p) for p in pts]
 
-        stacked = np.stack(G.g1_to_device(pts + phi, rep=rep)).astype(np.int16)
+        if group == "g1":
+            stacked = np.stack(G.g1_to_device(pts + phi, rep=rep))
+        else:
+            stacked = np.stack([
+                x for coord in G.g2_to_device(pts + phi, rep=rep)
+                for x in coord
+            ])
+        stacked = stacked.astype(np.int16)
         bits = jnp.asarray(
             G.scalars_to_bits(a + b, nbits=_WINDOW_BITS).astype(np.uint8)
         )
         base_inf = jnp.asarray(np.array([p is None for p in pts] * 2))
-        packed = np.asarray(fn(jnp.asarray(stacked), bits, base_inf))
+        packed = fn(jnp.asarray(stacked), bits, base_inf)
+        return (group, rep, B, size, packed)
 
-        out = packed[:-1]  # one bulk transfer, inf flags in the last row
-        host_pts = G.g1_from_device_batch(
-            (out[0], out[1], out[2]), rep=rep
-        )  # a·P rows, then b·φ(P)
+    def _mul_batch_collect(self, handle):
+        """Block on a :meth:`_mul_batch_dispatch` handle; returns host
+        Jacobian points (None = infinity), index-aligned with the
+        dispatched points."""
+        group, rep, B, size, packed = handle
+        packed = np.asarray(packed)  # ONE bulk transfer; the device fence
+        out = packed[:-1]  # inf flags ride in the last row
         inf_h = packed[-1, :, 0].astype(bool)
+        if group == "g1":
+            host_pts = G.g1_from_device_batch(
+                (out[0], out[1], out[2]), rep=rep
+            )  # a·P rows, then b·endo(P)
+            host_add = c.g1_add
+        else:
+            host_pts = G.g2_from_device_batch(
+                ((out[0], out[1]), (out[2], out[3]), (out[4], out[5])),
+                rep=rep,
+            )
+            host_add = c.g2_add
         res = []
         for i in range(B):
             lo = None if inf_h[i] else host_pts[i]
             hi = None if inf_h[size + i] else host_pts[size + i]
-            res.append(c.g1_add(lo, hi))
+            res.append(host_add(lo, hi))
         return res
+
+    def g1_mul_batch(self, points, scalars):
+        """Batched G1 scalar-mul for FULL-RANGE (mod r) scalars via GLV
+        (see :meth:`_mul_batch_dispatch`)."""
+        return self._mul_batch_collect(
+            self._mul_batch_dispatch(
+                "g1", points, scalars, c.g1_endo, c.LAMBDA_G1
+            )
+        )
+
+    def g2_mul_batch(self, points, scalars):
+        """Batched G2 scalar-mul for FULL-RANGE (mod r) scalars via the
+        GLS ψ² split (see :meth:`_mul_batch_dispatch`) — the W-ladder of
+        the split device encrypt."""
+        return self._mul_batch_collect(
+            self._mul_batch_dispatch(
+                "g2", points, scalars, c.g2_psi2, c.LAMBDA_G2
+            )
+        )
 
 
 _CACHES: Dict[Optional[object], _MsmCache] = {}
@@ -430,6 +476,188 @@ def batch_tpke_check_decrypt(pks, payloads, secret_shares):
     # the first malformed payload), then the batched decrypt
     cts = [tc.Ciphertext.from_bytes(p) for p in payloads]
     return batch_tpke_decrypt(pks, cts, secret_shares)
+
+
+# --------------------------------------------------------------------------
+# Split device TPKE encrypt (the flagship epoch's dominant host phase)
+# --------------------------------------------------------------------------
+#
+# One TPKE encrypt is U = r·g1, mask = r·pk, V = m ⊕ KDF(mask),
+# W = r·H_G2(U‖V).  The round-5 one-call native path costs ~920 µs/item at
+# N=4096 (BASELINE.md phase table), ~46 % of it hash-to-G2 — the only
+# genuinely host-shaped part.  This path splits the batch: the two G1
+# ladders and the GLS G2 ladder for ALL proposers run as device MSM
+# dispatches, while hash-to-G2 (+ KDF/XOR) stays in a native batch call —
+# and the two overlap through chunking: while the device runs chunk i's
+# W-ladder, the host hashes chunk i+1 (plus, one level up, the epoch
+# pipeline overlaps the whole phase with the previous epoch's ACS).
+#
+# MEASURED ROOFLINE (single chip — why AUTO routing keeps the host asm):
+# per item the split ladders are 4 G1 + 2 G2 lazy-ladder rows of 132
+# window bits ≈ 132·(4·18 + 2·55) ≈ 24 000 field row-muls.  The XLA
+# lowering of the lazy field measures ~135 ns/row-mul at 8192 rows
+# (ops/pallas_fp.py table), and the round-5 dkg256 artifact (2.09 s for a
+# 7396-mul GLV ladder = a 14 792-row × 132-bit launch, BENCH_r05.json)
+# implies ~60 ns effective — so ONE chip prices an encrypt at
+# ~1.4–3.2 ms/item against ~0.5 ms/item for the ADX host asm (40 ns/mul)
+# doing the same ladders.  This batch shape is COMPUTE-bound (the regime
+# pallas_fp.py's roofline assigns to the host; both device lowerings run
+# at ~1 % of VPU peak, bandwidth/fusion-bound), so the single-chip device
+# loses ~3–6× and no kernel choice changes that.  The device path wins
+# when the MSM rows shard across a mesh (``use_mesh`` — row-sharding is
+# collective-free, so 8 chips ≈ 0.2–0.4 ms/item < host asm) or when no
+# native oracle exists (pure-Python host is ~100× slower than the ladder).
+# AUTO routing (``tc.tpke_encrypt_batch``) encodes exactly that; set
+# HBBFT_ENCRYPT_BACKEND=device|native to override.
+
+# below this many items the launch overhead dominates any ladder win
+DEVICE_ENCRYPT_MIN_BATCH = 256
+
+# items per pipeline chunk: big enough to amortize dispatch (the G1 ladder
+# of a chunk is 4·CHUNK rows), small enough that the host hash of chunk
+# i+1 genuinely overlaps the device W-ladder of chunk i
+DEVICE_ENCRYPT_CHUNK = 1024
+
+
+def device_encrypt_worthwhile(n_items: int) -> bool:
+    """AUTO-routing policy for the split device encrypt (roofline above):
+    device only with a real accelerator AND either a >1-chip mesh routed
+    through :func:`use_mesh` (row-sharding beats the host asm) or no
+    native oracle (the pure-Python fallback loses to any ladder)."""
+    if n_items < DEVICE_ENCRYPT_MIN_BATCH:
+        return False
+    try:
+        import jax
+    except Exception:  # pragma: no cover - jax is a hard dep in practice
+        return False
+    if jax.default_backend() == "cpu":
+        return False
+    mesh = _CACHE.mesh
+    if mesh is not None and mesh.devices.size > 1:
+        # row-sharding only engages when the mesh size divides the padded
+        # ladder row counts (see _MsmCache._get's divisibility guard) —
+        # all powers of two here, so e.g. a 3- or 6-chip mesh would
+        # silently run the whole MSM on one chip, the regime the roofline
+        # above prices BEHIND the host asm.  The G2 ladder has the fewest
+        # rows (2·pad(chunk)); if the mesh divides that, it divides the
+        # 2×-larger G1 ladder too.
+        rows_g2 = 2 * _MsmCache._pad(min(n_items, DEVICE_ENCRYPT_CHUNK))
+        if rows_g2 % mesh.devices.size == 0:
+            return True
+    return c._native() is None
+
+
+def _g1_to_bytes_batch(pts) -> list:
+    """Affine-serialize host Jacobian G1 points with ONE shared field
+    inversion (a Montgomery batch-inversion chain over the z coordinates
+    — the Python mirror of the native ``g1_write_batch``).  Byte-identical
+    to per-point ``c.g1_to_bytes``, which costs a pow-based inversion
+    each: at N=4096 the split encrypt serializes 2×4096 points per epoch
+    on the host phase the chunk overlap is trying to hide."""
+    p = c.P
+    idx = [i for i, pt in enumerate(pts) if pt is not None]
+    zs = [pts[i][2] % p for i in idx]
+    out = [b"\x40" + bytes(96)] * len(pts)
+    if not zs:
+        return out
+    pre = [1] * (len(zs) + 1)
+    for i, z in enumerate(zs):
+        pre[i + 1] = pre[i] * z % p
+    acc = pow(pre[-1], -1, p)  # the chain's single inversion
+    for i in range(len(zs) - 1, -1, -1):
+        zi = acc * pre[i] % p  # = zs[i]^-1
+        acc = acc * zs[i] % p
+        x, y, _ = pts[idx[i]]
+        zi2 = zi * zi % p
+        out[idx[i]] = (
+            b"\x00"
+            + (x * zi2 % p).to_bytes(48, "big")
+            + (y * zi2 % p * zi % p).to_bytes(48, "big")
+        )
+    return out
+
+
+def batch_tpke_encrypt_device(pk_point, msgs: Sequence[bytes], rs):
+    """Encrypt ``msgs`` to one threshold key with the ladders on the chip.
+
+    ``pk_point``: the public key's G1 Jacobian point; ``rs``: one nonzero
+    scalar (mod r) per message, drawn by the caller — byte-identical to
+    the one-call native ``bls_tpke_encrypt_batch`` with the same scalars
+    (the cross-path equality test asserts it).  Returns ``tc.Ciphertext``
+    objects, index-aligned with ``msgs``.
+
+    Phase structure (per DEVICE_ENCRYPT_CHUNK items):
+      1. dispatch ALL chunks' G1 ladders up front — rows [g1…, pk…], GLV
+         split inside, one launch per chunk;
+      2. per chunk: collect U/mask → KDF/XOR V on host → hash-to-G2 in
+         ONE native batch call → dispatch the chunk's GLS G2 W-ladder.
+         The device runs chunk i's W-ladder while the host hashes i+1;
+      3. collect every W-ladder, assemble ciphertexts.
+    """
+    from hbbft_tpu.crypto import tc
+
+    n = len(msgs)
+    if n == 0:
+        return []
+    if len(rs) != n:
+        raise ValueError("need one scalar per message")
+    nat = c._native()
+    chunk = DEVICE_ENCRYPT_CHUNK
+    spans = [(lo, min(lo + chunk, n)) for lo in range(0, n, chunk)]
+
+    g1_handles = [
+        _CACHE._mul_batch_dispatch(
+            "g1",
+            [c.G1_GEN] * (hi - lo) + [pk_point] * (hi - lo),
+            list(rs[lo:hi]) * 2,
+            c.g1_endo, c.LAMBDA_G1,
+        )
+        for lo, hi in spans
+    ]
+
+    w_handles = []
+    us_all: list = []
+    vs_all: list = []
+    for (lo, hi), h in zip(spans, g1_handles):
+        pts = _CACHE._mul_batch_collect(h)
+        m = hi - lo
+        ser = _g1_to_bytes_batch(pts)  # U + mask rows, one inversion chain
+        u_bytes, mask_bytes = ser[:m], ser[m:]
+        vs = [
+            bytes(
+                a ^ b
+                for a, b in zip(msg, tc._kdf_stream(mb, len(msg)))
+            )
+            for msg, mb in zip(msgs[lo:hi], mask_bytes)
+        ]
+        hins = [
+            b"HBBFT-TPKE" + ub + v for ub, v in zip(u_bytes, vs)
+        ]
+        if nat is not None:
+            # host hash phase: one C call, GIL released throughout
+            hs = [
+                c._g2_from_bytes_trusted(hb)
+                for hb in nat.bls_hash_g2_batch(hins)
+            ]
+        else:
+            hs = [c.hash_g2(hin) for hin in hins]
+        w_handles.append(
+            _CACHE._mul_batch_dispatch(
+                "g2", hs, list(rs[lo:hi]), c.g2_psi2, c.LAMBDA_G2
+            )
+        )
+        # store U affine (it is already serialized) so Ciphertext.to_bytes
+        # does not re-run a per-point inversion later
+        us_all.extend(c._g1_from_bytes_trusted(ub) for ub in u_bytes)
+        vs_all.extend(vs)
+
+    ws_all: list = []
+    for h in w_handles:
+        ws_all.extend(_CACHE._mul_batch_collect(h))
+    return [
+        tc.Ciphertext(u, v, w)
+        for u, v, w in zip(us_all, vs_all, ws_all)
+    ]
 
 
 # --------------------------------------------------------------------------

@@ -593,6 +593,55 @@ def g2_psi(pt):
     )
 
 
+# --- ψ² — the GLS split the device G2 ladders use --------------------------
+#
+# The two conjugations in ψ∘ψ cancel, so ψ²(x, y, z) = (n_x·x, n_y·y, z)
+# with n_x = c_x·c̄_x, n_y = c_y·c̄_y ∈ Fp — a pure coordinate scaling, the
+# exact G2 analog of GLV's φ(x, y) = (β·x, y) on G1.  On the r-order
+# subgroup ψ² acts as multiplication by p² ≡ X² (mod r); X² ≈ 2^127.7, so a
+# full-range scalar splits as s = a + b·X² with a = s mod X², b = s ÷ X² —
+# both POSITIVE and < 2^128, the regime the device's lazy ladder is sound
+# in (ops/fp381.py), mirroring LAMBDA_G1.  Constants are derived, not
+# transcribed, and self-checked against the eigenvalue on the generator.
+
+LAMBDA_G2 = X * X  # ψ² eigenvalue on G2 (= LAMBDA_G1 + 1; no mod needed)
+assert 0 < LAMBDA_G2 < 1 << 128 and (R - 1) // LAMBDA_G2 < 1 << 128
+
+_PSI2: Optional[tuple] = None
+
+
+def _psi2_consts() -> tuple:
+    global _PSI2
+    if _PSI2 is None:
+        cx, cy = _psi_consts()
+        nx = fp2_mul(cx, fp2_conj(cx))
+        ny = fp2_mul(cy, fp2_conj(cy))
+        assert nx[1] == 0 and ny[1] == 0, "ψ² scalings must lie in Fp"
+        # eigenvalue self-check on the generator (pure-Python ladder, same
+        # reasoning as _psi_consts: the native oracle derives its constants
+        # from this module and must not be in the loop that validates them)
+        g = G2_GEN
+        cand = (fp2_scal(g[0], nx[0]), fp2_scal(g[1], ny[0]), g[2])
+        k = LAMBDA_G2
+        acc, add = None, g
+        while k:
+            if k & 1:
+                acc = g2_add(acc, add)
+            add = g2_double(add)
+            k >>= 1
+        assert g2_eq(cand, acc), "ψ² constants failed the eigenvalue check"
+        _PSI2 = (nx[0], ny[0])
+    return _PSI2
+
+
+def g2_psi2(pt):
+    """ψ²(P) = [X²]·P via two Fp2-by-Fp coordinate scalings (Jacobian)."""
+    if pt is None:
+        return None
+    nx, ny = _psi2_consts()
+    return (fp2_scal(pt[0], nx), fp2_scal(pt[1], ny), pt[2])
+
+
 def g2_in_subgroup(pt) -> bool:
     """Eigenvalue subgroup test: ψ(P) == [x]P ⟺ P ∈ G2 (for on-curve P).
 
@@ -933,6 +982,13 @@ def g1_in_subgroup(pt) -> bool:
 
 def g1_from_bytes(data: bytes):
     if data[0] == 0x40:
+        # strict: the only valid infinity encoding is the flag followed by
+        # 96 zero bytes — a consensus-validated wire format must not admit
+        # malleable (or truncated) encodings of the identity (the native
+        # g1_read_checked reads the same fixed 97-byte frame;
+        # tests/test_crypto.py sweeps the accept sets)
+        if len(data) < 97 or any(data[1:97]):
+            raise ValueError("nonzero bytes after the G1 infinity flag")
         return None
     if data[0] != 0:
         # strict decode: the only defined flags are 0x00 and 0x40 (the
@@ -968,6 +1024,9 @@ def g2_to_bytes(pt) -> bytes:
 
 def g2_from_bytes(data: bytes):
     if data[0] == 0x40:
+        # strict infinity: the full 193-byte frame, flag + zeros only
+        if len(data) < 193 or any(data[1:193]):
+            raise ValueError("nonzero bytes after the G2 infinity flag")
         return None
     if data[0] != 0:
         raise ValueError("invalid G2 flag byte")

@@ -76,19 +76,49 @@ def _hash_ciphertext_point(u, v: bytes):
     return c.hash_g2(b"HBBFT-TPKE" + c.g1_to_bytes(u) + v)
 
 
-def tpke_encrypt_batch(pk: "PublicKey", msgs: Sequence[bytes], rng) -> List["Ciphertext"]:
+def tpke_encrypt_batch(
+    pk: "PublicKey", msgs: Sequence[bytes], rng,
+    backend: Optional[str] = None,
+) -> List["Ciphertext"]:
     """Encrypt many contributions to one threshold key.
 
     Draws one scalar per message from ``rng`` and is byte-identical to
-    sequential ``pk.encrypt(msg, rng)`` calls (tests assert it).  With the
-    native oracle present the WHOLE batch is one C call — the GIL is
-    released throughout, so the epoch pipeline's encrypt-ahead thread
-    overlaps with device work for real (parallel/qhb.py), and the per-item
-    cost drops to the endomorphism fast paths (fixed-base U, windowed
-    pk^r, ψ-based hash-to-G2, GLS W) instead of 4+ per-op oracle round
-    trips.  This is the batched-device-encrypt lever of SURVEY §3.1's HOT
-    encrypt row."""
+    sequential ``pk.encrypt(msg, rng)`` calls regardless of backend
+    (tests assert it).  ``backend`` (default: env HBBFT_ENCRYPT_BACKEND,
+    then "auto"):
+
+    - ``"native"``: the WHOLE batch is one C call — GIL released
+      throughout, so the epoch pipeline's encrypt-ahead thread overlaps
+      with device work for real (parallel/qhb.py); per-item cost is the
+      endomorphism fast paths (fixed-base U, windowed pk^r, ψ-based
+      hash-to-G2, GLS W).  Falls back to per-item Python if the oracle is
+      unavailable.
+    - ``"device"``: the SPLIT path — 2×G1 + GLS-G2 ladders for all
+      proposers as device MSM dispatches, hash-to-G2 in a native batch
+      call, chunk-pipelined so the host hash overlaps the device ladders
+      (:func:`hbbft_tpu.crypto.batch.batch_tpke_encrypt_device`).
+    - ``"auto"``: device only where the measured roofline says it wins —
+      a >1-chip mesh on a real accelerator, or no native oracle; the
+      single-chip compute-bound regime stays with the 40 ns/mul host asm
+      (see the roofline note in crypto/batch.py).
+
+    This is the batched-device-encrypt lever of SURVEY §3.1's HOT encrypt
+    row."""
+    import os
+
     rs = [rng.randrange(1, R) for _ in msgs]
+    if backend is None:
+        backend = os.environ.get("HBBFT_ENCRYPT_BACKEND") or "auto"
+    if backend not in ("auto", "native", "device"):
+        raise ValueError(
+            f"HBBFT_ENCRYPT_BACKEND={backend!r}: expected "
+            "'auto', 'native' or 'device'"
+        )
+    if backend != "native":
+        from hbbft_tpu.crypto import batch as _batch
+
+        if backend == "device" or _batch.device_encrypt_worthwhile(len(msgs)):
+            return _batch.batch_tpke_encrypt_device(pk.point, msgs, rs)
     nat = c._native()
     if nat is not None:
         out = nat.bls_tpke_encrypt_batch(

@@ -14,6 +14,8 @@
 
 #include <array>
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <vector>
 
@@ -1217,9 +1219,26 @@ static bool g2_read(const uint8_t* in193, G2& o) {
 // muls replaces m inversions.  The batch TPKE entry points spend ~10 % of
 // their time in per-point affine pow-inversions without this.
 
+// Inputs to both batch-inversion chains MUST be nonzero: one zero element
+// would zero every prefix product and silently corrupt the WHOLE batch
+// (the old per-point path corrupted only its own output).  Callers uphold
+// this by filtering infinity points (the only source of z = 0) before the
+// chain; the guard makes a future caller that forgets fail loudly.
+
+static void batch_inv_zero_guard(const u64* limbs, int n, const char* who) {
+  for (int i = 0; i < n; ++i)
+    if (limbs[i]) return;
+  std::fprintf(stderr,
+               "hbbft native: %s got a zero element — inputs must be "
+               "nonzero (filter infinity/z=0 points before the shared "
+               "inversion chain)\n", who);
+  std::abort();
+}
+
 static void fp_batch_inv(std::vector<std::array<u64, 6>>& vals) {
   int m = (int)vals.size();
   if (m == 0) return;
+  for (auto& v : vals) batch_inv_zero_guard(v.data(), 6, "fp_batch_inv");
   std::vector<std::array<u64, 6>> pre(m);
   pre[0] = vals[0];
   for (int i = 1; i < m; ++i)
@@ -1238,6 +1257,12 @@ static void fp_batch_inv(std::vector<std::array<u64, 6>>& vals) {
 static void f2_batch_inv(std::vector<Fp2>& vals) {
   int m = (int)vals.size();
   if (m == 0) return;
+  for (auto& v : vals) {
+    u64 both[12];
+    memcpy(both, v.a, sizeof(v.a));
+    memcpy(both + 6, v.b, sizeof(v.b));
+    batch_inv_zero_guard(both, 12, "f2_batch_inv");
+  }
   std::vector<Fp2> pre(m);
   pre[0] = vals[0];
   for (int i = 1; i < m; ++i) f2_mul(pre[i - 1], vals[i], pre[i]);
@@ -1724,6 +1749,11 @@ static bool g2_subgroup_ok(const G2& p) {
 // coordinates canonical; on-curve; subgroup).
 static bool g1_read_checked(const uint8_t* in97, G1& o) {
   if (in97[0] == 0x40) {
+    // strict: the flag must be followed by all-zero bytes — no malleable
+    // encodings of the identity on the validated wire (Python
+    // g1_from_bytes enforces the same accept set)
+    for (int i = 1; i < 97; ++i)
+      if (in97[i]) return false;
     o.inf = true;
     return true;
   }
@@ -1737,6 +1767,8 @@ static bool g1_read_checked(const uint8_t* in97, G1& o) {
 
 static bool g2_read_checked(const uint8_t* in193, G2& o) {
   if (in193[0] == 0x40) {
+    for (int i = 1; i < 193; ++i)  // strict infinity: flag + zeros only
+      if (in193[i]) return false;
     o.inf = true;
     return true;
   }
@@ -2176,6 +2208,27 @@ int bls_tpke_check_decrypt_batch(const uint8_t* s_be32,
     pp += plens[i];
     op += vlen;
   }
+  return 0;
+}
+
+// Hash `count` messages to G2 in one call — the host half of the SPLIT
+// device encrypt (crypto/batch.py::batch_tpke_encrypt_device): the ladders
+// (2×fixed-base G1, GLS G2) run as device MSMs while this hash-dominated
+// phase stays on the host.  All affine writes share ONE Fp2 inversion
+// chain; the GIL is released by ctypes for the whole batch, so the epoch
+// pipeline's encrypt thread overlaps with device dispatches for real.
+int bls_hash_g2_batch(const uint8_t* msgs, const int64_t* lens, int count,
+                      uint8_t* out193s) {
+  init_all();
+  std::vector<G2> hs(count);
+  std::vector<uint8_t*> outs(count);
+  const uint8_t* mp = msgs;
+  for (int i = 0; i < count; ++i) {
+    hash_g2_point(mp, lens[i], hs[i]);
+    outs[i] = out193s + 193 * (size_t)i;
+    mp += lens[i];
+  }
+  g2_write_batch(hs, outs);
   return 0;
 }
 

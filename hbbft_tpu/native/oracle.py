@@ -80,6 +80,7 @@ class NativeOracle:
             ("bls_tpke_encrypt_batch", [u8p, u8p, i64p, i, u8p, u8p], i),
             ("bls_tpke_mask_batch", [u8p, u8p, i, u8p], i),
             ("bls_coin_batch", [u8p, u8p, i64p, i, u8p], i),
+            ("bls_hash_g2_batch", [u8p, i64p, i, u8p], i),
             ("bls_g1_in_subgroup", [u8p], i),
             ("bls_g2_in_subgroup", [u8p], i),
             ("bls_tpke_decrypt_batch", [u8p, u8p, u8p, i64p, i, u8p], i),
@@ -406,6 +407,22 @@ class NativeOracle:
             res.append(ob[off:off + vlen])
             off += vlen
         return res
+
+    def bls_hash_g2_batch(self, msgs) -> list:
+        """H_G2(msg) for every message in ONE native call (GIL released;
+        affine writes share one Fp2 inversion chain) — the host hash half
+        of the split device encrypt.  Byte-identical to per-item
+        ``bls_hash_g2``.  Returns 193-byte G2 encodings."""
+        if not msgs:
+            return []
+        lens = (ctypes.c_int64 * len(msgs))(*[len(m) for m in msgs])
+        cat = self._arr(b"".join(msgs) or b"\0")
+        out = self._buf(193 * len(msgs))
+        assert self._lib.bls_hash_g2_batch(
+            self._p(cat), lens, len(msgs), self._p(out),
+        ) == 0
+        ob = out.tobytes()
+        return [ob[i * 193:(i + 1) * 193] for i in range(len(msgs))]
 
     def bls_coin_batch(self, scalar: int, nonces) -> list:
         """parity(SHA3(g2_bytes([scalar]·H_G2(nonce)))) per nonce — a whole

@@ -270,10 +270,14 @@ class BatchedHoneyBadgerEpoch:
         bytes when encrypting; accepted payloads are re-parsed at decrypt
         time, so nothing else needs the Ciphertext objects).
 
-        All N proposers encrypt in ONE native batch call
-        (``tc.tpke_encrypt_batch``: endomorphism fast paths + amortized
-        fixed-base tables + a single GIL release) — the round-4 24 s serial
-        loop at N=4096 collapses to the per-item ψ/GLS cost."""
+        All N proposers encrypt in ONE ``tc.tpke_encrypt_batch`` call —
+        the round-4 24 s serial loop at N=4096 collapses to the per-item
+        ψ/GLS cost.  The backend routes by measured roofline (see
+        crypto/batch.py): one native C call (endomorphism fast paths +
+        amortized fixed-base tables + a single GIL release), or the SPLIT
+        device path — all proposers' G1/G2 ladders as device MSM
+        dispatches chunk-pipelined against the native hash-to-G2 batch —
+        when a mesh is attached; HBBFT_ENCRYPT_BACKEND overrides."""
         from hbbft_tpu.crypto import tc
 
         contribs = [contributions.get(nid, b"") for nid in self.ids]

@@ -137,8 +137,11 @@ class BatchedQueueingHoneyBadger:
     def run_epochs_pipelined(self, rng, n_epochs: int,
                              on_epoch: Optional[Callable] = None) -> int:
         """Run ``n_epochs`` with epoch-axis overlap (SURVEY §2.3 PP row):
-        epoch e+1's host TPKE encryption runs on a worker thread (native
-        oracle, GIL released) while epoch e's ACS drives the device.
+        epoch e+1's TPKE encryption runs on a worker thread (native
+        oracle, GIL released — or the split device-MSM path, whose
+        hash-to-G2 half is itself a GIL-released native batch call and
+        whose ladder dispatches interleave with epoch e's on the device
+        queue) while epoch e's ACS drives the device.
 
         Pipelining divergence, documented: epoch e+1's proposals are
         sampled BEFORE epoch e's commits prune the queues — the in-flight

@@ -491,9 +491,10 @@ class BatchedRbc:
         the per-proposer framed lengths: only ``max(ln)+4`` leading bytes
         of each row cross the link (the rest of a frame is zero padding —
         the inverse of :meth:`upload_framed`'s compaction).  Rows whose
-        framing check failed contribute nothing to the bound; their
-        returned bytes beyond the fetch window are zeros, which no caller
-        reads (not delivered).  ``frame_ok=None`` derives the framing
+        framing check failed contribute nothing to the bound and are
+        masked to ALL-ZEROS in the returned array — a fault row is only
+        partially inside the fetch window, and partial bytes must never
+        read as real shard data.  ``frame_ok=None`` derives the framing
         verdict from the fetched lengths (the all-match fast path, where
         data rows are the committed shards verbatim).  Returns
         ``(host (P, k, B) uint8 array, ln, frame_ok)``."""
@@ -527,6 +528,12 @@ class BatchedRbc:
         host[:, :maxb] = np.asarray(
             self._jit(("head", P, kb, maxb), head)(out_data)
         )
+        # fault rows come back ALL-ZERO: a row whose framing failed is
+        # only partially inside the fetch window, and partial row bytes
+        # must never be mistakable for real shard data by a future
+        # (diagnostic/observability) consumer — delivered rows are the
+        # only ones carrying payload
+        host[~np.asarray(frame_ok)] = 0
         return host.reshape(P, k, B), ln, frame_ok
 
     def finish_large(self, stage_a_out, stage_b_fn):

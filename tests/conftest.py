@@ -67,3 +67,111 @@ def pytest_collection_modifyitems(config, items):
 def rng():
     """Seeded RNG — every test failure reproduces from this seed."""
     return random.Random(0x48425446)  # "HBTF"
+
+
+# ---------------------------------------------------------------------------
+# Session-scoped shared keygen / DKG runs
+#
+# The BLS-heavy DHB/DKG tests were 4 of the suite's 10 slowest: every
+# driver instance re-TRACES the full batched-ACS graph for each payload
+# shape it meets (the persistent cache stores XLA executables, not Python
+# traces), so re-running a DKG rotation per test pays tens of seconds of
+# pure tracing each time.  These fixtures run each expensive scenario ONCE
+# per session and hand tests the recorded artifacts (batches, rotated
+# validator sets, era-1 results) to assert on.  Consumers must treat the
+# returned objects as READ-ONLY; a test that needs to drive epochs itself
+# builds its own driver.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="session")
+def shared_netinfo():
+    """Session-scoped ``NetworkInfo.generate_map`` cache: the BLS keygen
+    for a given (n, seed) runs once per suite.  The returned maps are
+    shared — read-only by contract (drivers copy the dict and never mutate
+    the NetworkInfo objects)."""
+    from hbbft_tpu.netinfo import NetworkInfo
+
+    cache = {}
+
+    def get(n: int, seed: int):
+        if (n, seed) not in cache:
+            cache[(n, seed)] = NetworkInfo.generate_map(
+                list(range(n)), random.Random(seed)
+            )
+        return cache[(n, seed)]
+
+    return get
+
+
+def _run_dkg_scenario(infos, vote, era1_payload):
+    """One complete DKG era rotation on a fresh array driver: vote, drive
+    epochs until the change completes, then run one era-1 epoch under the
+    ROTATED keys.  Returns every artifact the consuming tests assert on."""
+    from hbbft_tpu.parallel.dhb import BatchedDynamicHoneyBadger
+
+    dhb = BatchedDynamicHoneyBadger(
+        infos, session_id=b"dhb-arr", rng=random.Random(77)
+    )
+    vote(dhb)
+    b0 = dhb.run_epoch(
+        {nid: b"e0-%d" % nid for nid in dhb.validators}
+    )
+    final = (
+        b0 if b0.change.state == "complete"
+        else dhb.run_until_change_completes()
+    )
+    era1_validators = sorted(dhb.validators)
+    era1_contribs = {nid: era1_payload(nid) for nid in dhb.validators}
+    b1 = dhb.run_epoch(era1_contribs)
+    join_plan_error = None
+    try:
+        dhb.join_plan()
+    except ValueError as exc:
+        join_plan_error = exc
+    return {
+        "batches": list(dhb.batches),
+        "b0": b0,
+        "final": final,
+        "era": dhb.era,
+        "era1_validators": era1_validators,
+        "era1_contribs": era1_contribs,
+        "era1_batch": b1,
+        "join_plan_error": join_plan_error,
+    }
+
+
+@pytest.fixture(scope="session")
+def dkg_remove_run(shared_netinfo):
+    """Remove-validator rotation at the cross-mode scenario's shape
+    (n=4, seed 31, everyone votes node 3 out, epoch-0 payloads
+    ``e0-<nid>``) — shared by the rotation test AND the array side of the
+    cross-mode equality test."""
+
+    def vote(dhb):
+        for voter in range(4):
+            dhb.vote_to_remove(voter, 3)
+
+    return _run_dkg_scenario(
+        shared_netinfo(4, 31), vote, lambda nid: b"era1-%d" % nid
+    )
+
+
+@pytest.fixture(scope="session")
+def dkg_add_run(shared_netinfo):
+    """Add-validator rotation (n=4 → 5, seed 5): candidate 4 joins via
+    DKG; the era-1 epoch includes its contribution.  The single most
+    expensive scenario in the suite — run once, asserted on by the
+    add-validator test (and the completion half of the recoverable-missing-
+    key test, which now only asserts its DKG *starts*)."""
+    from hbbft_tpu.crypto import tc
+
+    new_sk = tc.SecretKey.random(random.Random(99))
+
+    def vote(dhb):
+        for voter in range(4):
+            dhb.vote_to_add(voter, 4, new_sk.public_key(), secret_key=new_sk)
+
+    return _run_dkg_scenario(
+        shared_netinfo(4, 5), vote, lambda nid: b"era1-%d" % nid
+    )

@@ -67,6 +67,59 @@ def test_point_serialization_roundtrip():
         c.g1_from_bytes(b"\x00" + bytes(96))
 
 
+def test_infinity_encoding_is_strict_cross_implementation(rng):
+    """The ONLY valid infinity encoding is the 0x40 flag followed by
+    all-zero bytes: both the Python deserializers and the native checked
+    wire readers must reject every malleable variant (nonzero trailing
+    bytes after the flag), and accept the canonical one — the accept sets
+    may not diverge (ADVICE r5 #1)."""
+    # Python side: canonical accepted, every mutated trailing byte rejected
+    assert c.g1_from_bytes(b"\x40" + bytes(96)) is None
+    assert c.g2_from_bytes(b"\x40" + bytes(192)) is None
+    for pos in (1, 48, 96):
+        bad = bytearray(b"\x40" + bytes(96))
+        bad[pos] = 0x5A
+        with pytest.raises(ValueError, match="infinity"):
+            c.g1_from_bytes(bytes(bad))
+    for pos in (1, 97, 192):
+        bad = bytearray(b"\x40" + bytes(192))
+        bad[pos] = 0x5A
+        with pytest.raises(ValueError, match="infinity"):
+            c.g2_from_bytes(bytes(bad))
+    # truncated infinity frames are rejected too (the native readers
+    # consume a fixed 97/193-byte frame; Python must not accept less)
+    with pytest.raises(ValueError, match="infinity"):
+        c.g1_from_bytes(b"\x40")
+    with pytest.raises(ValueError, match="infinity"):
+        c.g2_from_bytes(b"\x40" + bytes(10))
+
+    # native side: the checked wire readers (reached through the fused
+    # check+decrypt entry point) must reject exactly the same encodings —
+    # on rejection the batch falls back to the per-item Python parse,
+    # which raises; agreement is what this asserts
+    from hbbft_tpu.crypto import batch as BT
+
+    sks = tc.SecretKeySet.random(1, rng)
+    pks = sks.public_keys()
+    ct = pks.public_key().encrypt(b"strict", rng)
+    shares = [(i, sks.secret_key_share(i)) for i in range(2)]
+    inf_u = tc.Ciphertext(None, b"strict", ct.w).to_bytes()
+    # canonical infinity-U decrypts on the native path
+    assert BT.batch_tpke_check_decrypt(pks, [inf_u], shares) is not None
+    for pos in (5, 50, 96):  # inside U's zero region
+        bad = bytearray(inf_u)
+        bad[pos] = 0x5A
+        with pytest.raises(ValueError, match="infinity"):
+            BT.batch_tpke_check_decrypt(pks, [bytes(bad)], shares)
+    inf_w = tc.Ciphertext(ct.u, b"strict", None).to_bytes()
+    assert BT.batch_tpke_check_decrypt(pks, [inf_w], shares) is not None
+    for pos in (97 + 5, 97 + 100, 97 + 192):  # inside W's zero region
+        bad = bytearray(inf_w)
+        bad[pos] = 0x5A
+        with pytest.raises(ValueError, match="infinity"):
+            BT.batch_tpke_check_decrypt(pks, [bytes(bad)], shares)
+
+
 def test_plain_sign_verify(rng):
     sk = tc.SecretKey.random(rng)
     pk = sk.public_key()

@@ -75,6 +75,89 @@ def test_batch_tpke_decrypt_host_and_device_paths(keys):
         BT.DEVICE_DECRYPT_MIN_BATCH = old
 
 
+def test_g2_mul_batch_matches_host(keys):
+    """The GLS ψ²-split device G2 ladder (the W-ladder of the split
+    encrypt) against the host ground truth, over full-range scalars
+    including the split edges."""
+    from hbbft_tpu.crypto import batch as BT
+    from hbbft_tpu.crypto import bls12_381 as c
+
+    pts = [c.hash_g2(b"g2mb-0"), c.hash_g2(b"g2mb-1")]
+    scalars = [c.R - 1, c.LAMBDA_G2 + 3]
+    out = BT._CACHE.g2_mul_batch(pts, scalars)
+    for p, s, o in zip(pts, scalars, out):
+        assert c.g2_eq(o, c.g2_mul(p, s))
+    # infinity base rides through index-aligned
+    out = BT._CACHE.g2_mul_batch([None, pts[0]], [5, 7])
+    assert out[0] is None
+    assert c.g2_eq(out[1], c.g2_mul(pts[0], 7))
+
+
+def test_tpke_encrypt_device_path_matches_native(keys, monkeypatch):
+    """Tentpole cross-path equality: the SPLIT device encrypt (G1/G2
+    ladders as device MSMs, hash-to-G2 in the native batch call) must be
+    BYTE-IDENTICAL to the one-call native encrypt on randomized inputs —
+    same rng draw order, so the same scalars."""
+    import random
+
+    from hbbft_tpu.crypto import tc
+
+    rng, sks, pks = keys
+    pk = pks.public_key()
+    msgs = [
+        bytes(rng.getrandbits(8) for _ in range(ln)) for ln in (0, 1, 33)
+    ][:2]  # 2 msgs: the ladders reuse the suite's g1@8 jit key
+
+    nat_cts = tc.tpke_encrypt_batch(
+        pk, msgs, random.Random(2024), backend="native"
+    )
+    dev_cts = tc.tpke_encrypt_batch(
+        pk, msgs, random.Random(2024), backend="device"
+    )
+    assert [a.to_bytes() for a in nat_cts] == [
+        b.to_bytes() for b in dev_cts
+    ]
+    # and the env knob routes the same way
+    monkeypatch.setenv("HBBFT_ENCRYPT_BACKEND", "device")
+    env_cts = tc.tpke_encrypt_batch(pk, msgs, random.Random(2024))
+    assert [a.to_bytes() for a in env_cts] == [
+        b.to_bytes() for b in dev_cts
+    ]
+    # the ciphertexts are REAL: they decrypt under the threshold key
+    shares = [(i, sks.secret_key_share(i)) for i in range(pks.threshold() + 1)]
+    from hbbft_tpu.crypto import batch as BT
+
+    assert BT.batch_tpke_decrypt(pks, dev_cts, shares) == msgs
+
+
+def test_tpke_encrypt_device_chunk_pipeline(keys):
+    """The chunked overlap structure (dispatch all G1 ladders, then per
+    chunk hash + dispatch G2 while later chunks hash) must not change a
+    single byte vs the unchunked path."""
+    import random
+
+    from hbbft_tpu.crypto import batch as BT
+    from hbbft_tpu.crypto import tc
+
+    rng, sks, pks = keys
+    pk = pks.public_key()
+    msgs = [b"chunk-%d" % i * (i + 1) for i in range(4)]
+    nat_cts = tc.tpke_encrypt_batch(
+        pk, msgs, random.Random(77), backend="native"
+    )
+    old = BT.DEVICE_ENCRYPT_CHUNK
+    try:
+        BT.DEVICE_ENCRYPT_CHUNK = 2  # 4 msgs → 2 chunks in flight
+        dev_cts = tc.tpke_encrypt_batch(
+            pk, msgs, random.Random(77), backend="device"
+        )
+    finally:
+        BT.DEVICE_ENCRYPT_CHUNK = old
+    assert [a.to_bytes() for a in nat_cts] == [
+        b.to_bytes() for b in dev_cts
+    ]
+
+
 def test_batch_tpke_check_decrypt_fused(keys):
     """The fused native parse+decrypt (one C call doing the full
     Ciphertext.from_bytes wire checks then the master-scalar decrypt)

@@ -31,6 +31,30 @@ def test_psi_eigenvalue_on_generator():
         assert H.g2_eq(lhs, rhs)
 
 
+def test_psi2_eigenvalue_and_split_bounds():
+    """ψ² — the GLS split of the device G2 ladders (split TPKE encrypt):
+    a pure Fp coordinate scaling acting as [X²] on G2, with both split
+    halves inside the lazy ladder's < 2^128 soundness regime."""
+    with H.pure_python():
+        p = H.g2_mul(H.G2_GEN, 987654321)
+        assert H.g2_eq(H.g2_psi2(p), H.g2_mul(p, H.LAMBDA_G2))
+        # ψ² == ψ∘ψ (the scaling constants really are the ψ norms)
+        assert H.g2_eq(H.g2_psi2(p), H.g2_psi(H.g2_psi(p)))
+    # every split s = a + b·λ₂ stays below the 2^128 ladder bound
+    assert 0 < H.LAMBDA_G2 < 1 << 128
+    assert (H.R - 1) // H.LAMBDA_G2 < 1 << 128
+    assert H.LAMBDA_G2 == H.LAMBDA_G1 + 1  # X² vs X²−1, both eigenvalues
+
+
+def test_hash_g2_batch_matches_per_item(oracle):
+    """The native batched hash-to-G2 (the host half of the split device
+    encrypt) is byte-identical to per-item ``bls_hash_g2``."""
+    msgs = [b"", b"a", b"HBBFT-TPKE" + bytes(range(97)), b"x" * 300]
+    batch = oracle.bls_hash_g2_batch(msgs)
+    assert batch == [oracle.bls_hash_g2(m) for m in msgs]
+    assert oracle.bls_hash_g2_batch([]) == []
+
+
 def test_psi_is_additive():
     with H.pure_python():
         rng = random.Random(3)

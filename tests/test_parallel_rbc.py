@@ -393,3 +393,7 @@ def test_large_n_compact_fetch_with_bad_framing():
     assert d[0, mask].all() and not fa[0, mask].any()
     for p in (0, 4, 6, n - 1):
         assert unframe_value(out["data"][0, p]) == values[p]
+    # the fault row comes back ALL-ZERO: a row whose framing failed is
+    # only partially inside the compact fetch window, and partial bytes
+    # must never be mistakable for real shard data
+    assert not np.asarray(out["data"])[0, 5].any()
