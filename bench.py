@@ -1042,6 +1042,152 @@ def sustained4096(epochs: int, n: int = 4096, tx_bytes: int = 64):
         emit()
 
 
+def net_cluster_bench(epochs_target: int = 20, n: int = 4,
+                      batch_size: int = 8, tx_size: int = 64):
+    """Localhost 4-node networked QHB benchmark (`--net`).
+
+    Spawns ``n`` node processes (``python -m hbbft_tpu.net.cluster``) on a
+    free localhost port range, pumps client transactions through the
+    :mod:`hbbft_tpu.net.client` frontend until every node has committed at
+    least ``epochs_target`` epochs, and reports epochs/sec plus end-to-end
+    p50/p99 submit→commit latency — the networked number "The Latency
+    Price of Threshold Cryptosystems" says to measure.  The baseline for
+    ``vs_baseline`` is the SAME workload on the in-process ``VirtualNet``
+    simulator (tx/s over wall clock): the ratio is the real-socket tax the
+    net stack pays over the crank loop.  One JSON line either way, same
+    contract as the config pass.
+    """
+    import asyncio
+    import random
+    import subprocess
+
+    from hbbft_tpu.net.client import latency_percentiles
+    from hbbft_tpu.net.cluster import (
+        ClusterConfig, assert_status_chains_consistent, connect_when_up,
+        find_free_base_port, shutdown_procs, spawn_node,
+    )
+
+    cfg = ClusterConfig(n=n, seed=9, batch_size=batch_size,
+                        base_port=find_free_base_port(n))
+    procs = {nid: spawn_node(cfg, nid, stdout=subprocess.DEVNULL,
+                             stderr=subprocess.STDOUT)
+             for nid in range(n)}
+
+    async def session():
+        clients = [
+            await connect_when_up(cfg, nid, client_id=f"bench-{nid}")
+            for nid in range(n)
+        ]
+        rng = random.Random(17)
+        t0 = time.monotonic()
+        wave = 0
+        while True:
+            txs = [
+                b"%06d:" % (wave * 100 + i)
+                + bytes(rng.randrange(256) for _ in range(tx_size - 7))
+                for i in range(4 * batch_size)
+            ]
+            # overlap the submits and the commit waits: the benchmark
+            # must measure the cluster, not a serialized submitter
+            await asyncio.gather(*(
+                clients[i % n].submit(tx) for i, tx in enumerate(txs)
+            ))
+            await asyncio.gather(*(
+                clients[i % n].wait_committed(tx, timeout_s=120)
+                for i, tx in enumerate(txs)
+            ))
+            wave += 1
+            docs = [await c.status() for c in clients]
+            if min(d["batches"] for d in docs) >= epochs_target:
+                break
+            if wave > 50 * epochs_target:
+                raise RuntimeError("cluster failed to reach epoch target")
+        wall = time.monotonic() - t0
+        # identical batches everywhere — and the chains must actually
+        # overlap, or nothing was compared (status_doc truncates chains).
+        # Not a bare assert: the check must survive python -O.
+        if assert_status_chains_consistent(docs) == 0:
+            raise RuntimeError("no digest-chain overlap to compare")
+        lat = latency_percentiles(
+            l for c in clients for _d, l in c.latencies
+        )
+        out = {
+            "epochs": min(d["batches"] for d in docs),
+            "wall_s": wall,
+            "committed_txs": lat["count"],
+            "p50_ms": round(lat["p50_s"] * 1e3, 2),
+            "p90_ms": round(lat["p90_s"] * 1e3, 2),
+            "p99_ms": round(lat["p99_s"] * 1e3, 2),
+            "transport": docs[0]["stats"],
+        }
+        for c in clients:
+            await c.close()
+        return out
+
+    try:
+        net = asyncio.run(session())
+    finally:
+        shutdown_procs(procs.values())
+
+    # -- simulator baseline: identical workload on VirtualNet ----------------
+    from hbbft_tpu.netinfo import NetworkInfo
+    from hbbft_tpu.protocols.dynamic_honey_badger import DynamicHoneyBadger
+    from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
+    from hbbft_tpu.protocols.queueing_honey_badger import (
+        QhbBatch, QueueingHoneyBadger, TxInput,
+    )
+    from hbbft_tpu.sim import NetBuilder
+
+    infos = NetworkInfo.generate_map(list(range(n)), random.Random(9))
+    sim = NetBuilder(list(range(n))).using_step(
+        lambda nid: QueueingHoneyBadger(
+            DynamicHoneyBadger(
+                infos[nid], infos[nid].secret_key(),
+                rng=random.Random(7000 + nid),
+                encryption_schedule=EncryptionSchedule.never(),
+            ),
+            batch_size=batch_size, rng=random.Random(8000 + nid),
+        )
+    )
+    # identical workload: same tx count AND size (shard/merkle work
+    # scales with payload bytes)
+    sim_txs = [
+        (b"sim-%06d:" % i).ljust(tx_size, b"\x5a")
+        for i in range(net["committed_txs"])
+    ]
+    t0 = time.perf_counter()
+    for i, tx in enumerate(sim_txs):
+        sim.send_input(i % n, TxInput(tx))
+    sim.run_to_quiescence()
+    sim_wall = time.perf_counter() - t0
+    sim_epochs = sum(
+        1 for o in sim.nodes[0].outputs if isinstance(o, QhbBatch)
+    )
+
+    net_tx_rate = net["committed_txs"] / net["wall_s"]
+    sim_tx_rate = len(sim_txs) / max(sim_wall, 1e-9)
+    line = {
+        "metric": f"net_qhb{n}_localhost",
+        "value": round(net["epochs"] / net["wall_s"], 3),
+        "unit": "epochs/s",
+        # real sockets vs the in-process simulator crank loop on the SAME
+        # workload: < 1 is the expected price of actual networking
+        "vs_baseline": round(net_tx_rate / sim_tx_rate, 3),
+        "shape": f"N={n} f={(n - 1) // 3} batch={batch_size} "
+                 f"tx={tx_size}B",
+        "epochs": net["epochs"],
+        "committed_txs": net["committed_txs"],
+        "tx_per_s": round(net_tx_rate, 1),
+        "p50_latency_ms": net["p50_ms"],
+        "p90_latency_ms": net["p90_ms"],
+        "p99_latency_ms": net["p99_ms"],
+        "sim_baseline_tx_per_s": round(sim_tx_rate, 1),
+        "sim_baseline_epochs": sim_epochs,
+        "transport": net["transport"],
+    }
+    print(json.dumps(line), flush=True)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", choices=[*CONFIGS, "all"], default="all")
@@ -1049,6 +1195,14 @@ def main(argv=None):
         "--sustained", type=int, metavar="EPOCHS", default=0,
         help="run a sustained N=4096 multi-epoch session instead of the "
         "config pass (records per-epoch time + drift)",
+    )
+    ap.add_argument(
+        "--net", type=int, nargs="?", const=20, default=0,
+        metavar="EPOCHS",
+        help="run the localhost 4-node networked QHB benchmark "
+             "(real processes + sockets via hbbft_tpu.net) until every "
+             "node commits EPOCHS epochs; reports epochs/s and p50/p99 "
+             "client tx latency",
     )
     ap.add_argument(
         "--freeze-baselines", action="store_true",
@@ -1060,6 +1214,10 @@ def main(argv=None):
 
     if args.freeze_baselines:
         freeze_baselines()
+        return
+
+    if args.net:
+        net_cluster_bench(epochs_target=args.net)
         return
 
     if args.sustained:

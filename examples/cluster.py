@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Run a real multi-process localhost QHB cluster and pump load at it.
+
+The networked sibling of ``examples/simulation.py``: instead of the
+in-process simulator crank loop, this spawns ``--nodes`` OS processes
+(``python -m hbbft_tpu.net.cluster``), each listening on
+``base_port + node_id``, then drives ``--txs`` client transactions through
+``--clients`` concurrent frontends and reports epochs/sec and end-to-end
+submit→commit latency percentiles.
+
+    python examples/cluster.py --nodes 4 --txs 200 --batch-size 8
+
+Single-node mode (what the launcher spawns, also usable by hand across
+machines sharing the same --seed):
+
+    python -m hbbft_tpu.net.cluster --nodes 4 --node-id 0 \
+        --seed 0 --base-port 24000
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from hbbft_tpu.net.client import ClusterClient, latency_percentiles
+from hbbft_tpu.net.cluster import (
+    ClusterConfig,
+    connect_when_up,
+    find_free_base_port,
+    shutdown_procs,
+    spawn_node,
+)
+
+
+async def run_load(cfg: ClusterConfig, txs: int, tx_size: int,
+                   n_clients: int):
+    clients = [
+        await connect_when_up(cfg, c % cfg.n, client_id=f"load-{c}")
+        for c in range(n_clients)
+    ]
+    t0 = time.monotonic()
+
+    async def drive(ci: int, client: ClusterClient):
+        for i in range(ci, txs, n_clients):
+            tx = b"%08d:" % i + os.urandom(max(0, tx_size - 9))
+            await client.submit(tx)
+            await client.wait_committed(tx, timeout_s=120)
+
+    await asyncio.gather(*(drive(ci, c) for ci, c in enumerate(clients)))
+    wall = time.monotonic() - t0
+
+    status = await clients[0].status()
+    lat = latency_percentiles(l for c in clients for _d, l in c.latencies)
+    print(f"\ncommitted {lat['count']} txs "
+          f"in {status['batches']} epochs; wall {wall:.2f}s "
+          f"({status['batches'] / wall:.1f} epochs/s, "
+          f"{lat['count'] / wall:.0f} tx/s)")
+    print(f"latency p50 {lat['p50_s'] * 1e3:.1f} ms | "
+          f"p90 {lat['p90_s'] * 1e3:.1f} ms | "
+          f"p99 {lat['p99_s'] * 1e3:.1f} ms")
+    print(f"node 0 transport: {status['stats']}")
+    for c in clients:
+        await c.close()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--txs", type=int, default=200)
+    ap.add_argument("--tx-size", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--base-port", type=int, default=0,
+                    help="0 → pick a free range automatically")
+    ap.add_argument("--encrypt", action="store_true",
+                    help="TPKE-encrypt contributions (EncryptionSchedule "
+                         "always instead of never)")
+    args = ap.parse_args()
+
+    cfg = ClusterConfig(
+        n=args.nodes, seed=args.seed,
+        base_port=args.base_port or find_free_base_port(args.nodes),
+        batch_size=args.batch_size, encrypt=args.encrypt,
+    )
+    print(f"spawning {cfg.n} node processes on "
+          f"{cfg.host}:{cfg.base_port}..{cfg.base_port + cfg.n - 1}…")
+    procs = {nid: spawn_node(cfg, nid) for nid in range(cfg.n)}
+
+    async def session():
+        # connect_when_up retries per node, so the load clients double as
+        # the cluster-is-up barrier
+        print("cluster spawning; pumping load once nodes accept…")
+        await run_load(cfg, args.txs, args.tx_size, args.clients)
+
+    try:
+        asyncio.run(session())
+    finally:
+        shutdown_procs(procs.values())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Real async networking for the sans-I/O consensus stack.
+
+Layering (bottom up):
+
+- :mod:`~hbbft_tpu.net.framing` — length-prefixed, size-capped frames over
+  the :mod:`hbbft_tpu.protocols.wire` codec, with a versioned hello;
+- :mod:`~hbbft_tpu.net.transport` — asyncio TCP peer connections: per-peer
+  persistent outbound queues, seeded deterministic exponential backoff,
+  heartbeats and dead-peer detection;
+- :mod:`~hbbft_tpu.net.runtime` — :class:`NodeRuntime` hosts any
+  ``SenderQueue``-wrappable :class:`~hbbft_tpu.traits.ConsensusProtocol`
+  behind sockets, resolving ``Target`` routing and driving the
+  ``EpochStarted`` catch-up path for lagging/restarted peers;
+- :mod:`~hbbft_tpu.net.client` — bounded dedup'd mempool (node side) and
+  the :class:`ClusterClient` contribute frontend with backpressure and
+  submit→commit latency tracking;
+- :mod:`~hbbft_tpu.net.cluster` — cluster assembly: in-process
+  :class:`LocalCluster` (tests/bench) and per-node subprocess entry
+  (``python -m hbbft_tpu.net.cluster``).
+
+The deterministic in-process simulators (``sim/virtual_net.py`` and the
+batched ``parallel/`` drivers) remain the test harnesses; this package is
+how the same protocol objects run as long-lived networked processes.
+"""
+
+from hbbft_tpu.net.client import ClusterClient, Mempool
+from hbbft_tpu.net.cluster import ClusterConfig, LocalCluster
+from hbbft_tpu.net.framing import (
+    FrameDecoder,
+    FrameError,
+    Hello,
+    PROTOCOL_VERSION,
+)
+from hbbft_tpu.net.runtime import NodeRuntime
+from hbbft_tpu.net.transport import BackoffPolicy, Transport, TransportStats
+
+__all__ = [
+    "BackoffPolicy",
+    "ClusterClient",
+    "ClusterConfig",
+    "FrameDecoder",
+    "FrameError",
+    "Hello",
+    "LocalCluster",
+    "Mempool",
+    "NodeRuntime",
+    "PROTOCOL_VERSION",
+    "Transport",
+    "TransportStats",
+]

@@ -1,0 +1,354 @@
+"""Client-facing pieces: the node-side mempool and the contribute frontend.
+
+:class:`Mempool` is the node's admission gate — bounded and dedup'd, so a
+client flood turns into ``ACK_FULL`` backpressure instead of unbounded
+QueueingHoneyBadger queues, and a replayed transaction (pending *or*
+recently committed) is acknowledged without being re-proposed.
+
+:class:`ClusterClient` is the load-generator side: it dials a node, submits
+raw transaction bytes, honours backpressure (FULL acks retry with capped
+exponential delay), and records submit→commit latency per transaction — the
+end-to-end number "The Latency Price of Threshold Cryptosystems" says is
+the one that matters.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import struct
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from hbbft_tpu.net import framing
+from hbbft_tpu.net.framing import (
+    DEFAULT_MAX_FRAME,
+    FrameDecoder,
+    FrameError,
+    Hello,
+    ROLE_CLIENT,
+)
+
+
+def tx_digest(tx: bytes) -> bytes:
+    return hashlib.sha3_256(tx).digest()
+
+
+def latency_percentiles(latencies) -> Dict[str, float]:
+    """p50/p90/p99/max summary of a sequence of latency seconds."""
+    vals = sorted(latencies)
+    if not vals:
+        return {}
+
+    def pct(p: float) -> float:
+        return vals[min(len(vals) - 1, int(p * (len(vals) - 1) + 0.5))]
+
+    return {
+        "p50_s": pct(0.50), "p90_s": pct(0.90), "p99_s": pct(0.99),
+        "max_s": vals[-1], "count": len(vals),
+    }
+
+
+class Mempool:
+    """Bounded, dedup'd FIFO of not-yet-committed transactions.
+
+    ``max_tx_bytes`` bounds a single transaction at admission: a proposed
+    contribution is roughly ``batch_size · max_tx_bytes`` and must stay
+    well under ``wire.MAX_BLOB_BYTES`` (8 MiB) or its RBC shard messages
+    would be undeliverable — reject at the door, not mid-broadcast.  The
+    256 KiB default leaves a 4× margin at the default batch size of 8.
+    """
+
+    ACCEPTED = framing.ACK_ACCEPTED
+    DUPLICATE = framing.ACK_DUPLICATE
+    FULL = framing.ACK_FULL
+    REJECTED = framing.ACK_REJECTED
+
+    def __init__(self, capacity: int = 10_000, seen_cap: int = 100_000,
+                 max_tx_bytes: int = 256 * 1024,
+                 max_pending_bytes: int = 64 * 2**20):
+        self.capacity = capacity
+        self.seen_cap = seen_cap
+        self.max_tx_bytes = max_tx_bytes
+        # byte budget alongside the entry count: 10k max-size txs would
+        # otherwise admit ~2.5 GiB before FULL fires
+        self.max_pending_bytes = max_pending_bytes
+        self.pending_bytes = 0
+        self._pending: "OrderedDict[bytes, bytes]" = OrderedDict()  # digest→tx
+        self._seen: "OrderedDict[bytes, None]" = OrderedDict()  # recent commits
+
+    def add(self, tx: bytes) -> int:
+        if len(tx) > self.max_tx_bytes:
+            return self.REJECTED
+        digest = tx_digest(tx)
+        if digest in self._pending or digest in self._seen:
+            return self.DUPLICATE
+        if (len(self._pending) >= self.capacity
+                or self.pending_bytes + len(tx) > self.max_pending_bytes):
+            return self.FULL
+        self._pending[digest] = tx
+        self.pending_bytes += len(tx)
+        return self.ACCEPTED
+
+    def mark_committed(self, txs) -> List[bytes]:
+        """Drop committed txs from pending; returns their digests."""
+        digests = []
+        for tx in txs:
+            digest = tx_digest(tx)
+            digests.append(digest)
+            dropped = self._pending.pop(digest, None)
+            if dropped is not None:
+                self.pending_bytes -= len(dropped)
+            self._seen[digest] = None
+        while len(self._seen) > self.seen_cap:
+            self._seen.popitem(last=False)
+        return digests
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+
+class ClusterClient:
+    """Asyncio frontend for submitting transactions to one node."""
+
+    def __init__(self, addr: Tuple[str, int], cluster_id: bytes,
+                 client_id: str = "client",
+                 max_frame: int = DEFAULT_MAX_FRAME,
+                 connect_timeout_s: float = 5.0,
+                 keepalive_s: float = 10.0):
+        self.addr = addr
+        self.cluster_id = bytes(cluster_id)
+        self.client_id = client_id
+        self.max_frame = max_frame
+        self.connect_timeout_s = connect_timeout_s
+        # periodic PINGs keep an idle client (e.g. one parked in
+        # wait_committed) from tripping the node's inbound read deadline
+        self.keepalive_s = keepalive_s
+        self.node_hello: Optional[Hello] = None
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        # concurrent submit()/status() coroutines must not await
+        # writer.drain() simultaneously (asyncio's _drain_helper assert)
+        self._wlock = asyncio.Lock()
+        self._reader_task: Optional[asyncio.Task] = None
+        self._keepalive_task: Optional[asyncio.Task] = None
+        self._acks: Dict[bytes, asyncio.Future] = {}
+        # one future PER WAITER (asyncio.wait_for cancels the future it
+        # wraps, so sharing one would let a timed-out waiter break the
+        # others and leave a dead future pinned under the digest)
+        self._commits: Dict[bytes, List[asyncio.Future]] = {}
+        self._status_waiters: List[asyncio.Future] = []
+        self._submit_times: Dict[bytes, float] = {}
+        # commits already seen for OUR txs (bounded), so a wait_committed
+        # issued after the TX_COMMIT frame still resolves; foreign digests
+        # (other clients' txs, which nodes broadcast to everyone) are not
+        # retained at all
+        self._committed: "OrderedDict[bytes, float]" = OrderedDict()
+        self._committed_cap = 65_536
+        self._dead: Optional[Exception] = None
+        # (digest_hex, submit→commit seconds), in commit order
+        self.latencies: List[Tuple[str, float]] = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def connect(self) -> Hello:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(*self.addr), self.connect_timeout_s
+        )
+        self._reader, self._writer = reader, writer
+        hello = Hello(node_id=self.client_id, role=ROLE_CLIENT,
+                      cluster_id=self.cluster_id, era=0, epoch=0)
+        writer.write(framing.encode_frame(
+            framing.HELLO, framing.encode_hello(hello), self.max_frame
+        ))
+        await writer.drain()
+        kind, payload = await asyncio.wait_for(
+            framing.read_one_frame(reader, self.max_frame),
+            self.connect_timeout_s,
+        )
+        if kind != framing.HELLO:
+            raise FrameError("node did not answer with HELLO")
+        self.node_hello = framing.decode_hello(payload)
+        loop = asyncio.get_running_loop()
+        self._reader_task = loop.create_task(
+            self._recv_loop(), name=f"client-{self.client_id}"
+        )
+        self._keepalive_task = loop.create_task(
+            self._keepalive_loop(), name=f"client-ka-{self.client_id}"
+        )
+        return self.node_hello
+
+    async def close(self) -> None:
+        for task in (self._reader_task, self._keepalive_task):
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+        if self._writer is not None:
+            self._writer.close()
+
+    # -- submitting ----------------------------------------------------------
+
+    async def submit(self, tx: bytes, *, retry_full: bool = True,
+                     max_retries: int = 50,
+                     ack_timeout_s: float = 10.0) -> int:
+        """Submit ``tx``; waits for the node's ack.  ``ACK_FULL`` retries
+        with capped exponential delay (backpressure) unless ``retry_full``
+        is off.  Returns the final ack status."""
+        digest = tx_digest(tx)
+        delay = 0.02
+        status = framing.ACK_FULL
+        try:
+            for _attempt in range(max_retries):
+                self._check_alive()
+                fut = asyncio.get_running_loop().create_future()
+                self._acks[digest] = fut
+                self._submit_times.setdefault(digest, time.monotonic())
+                async with self._wlock:
+                    self._writer.write(framing.encode_frame(
+                        framing.TX, tx, self.max_frame
+                    ))
+                    await self._writer.drain()
+                try:
+                    status = await asyncio.wait_for(fut, ack_timeout_s)
+                finally:
+                    self._acks.pop(digest, None)  # timed-out ack entries
+                if status != framing.ACK_FULL or not retry_full:
+                    return status
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 1.0)
+            return status
+        finally:
+            # a tx that will never commit must not pin a submit-time entry
+            # forever: rejected/full outcomes are final, and a duplicate of
+            # an already-seen commit resolves from the bounded record
+            if status in (framing.ACK_REJECTED, framing.ACK_FULL) or (
+                status == framing.ACK_DUPLICATE and digest in self._committed
+            ):
+                self._submit_times.pop(digest, None)
+
+    async def wait_committed(self, tx: bytes, timeout_s: float = 60.0) -> float:
+        """Block until the node reports ``tx`` committed; returns the
+        submit→commit latency in seconds."""
+        digest = tx_digest(tx)
+        done = self._committed.get(digest)
+        if done is not None:
+            return done
+        self._check_alive()
+        fut = asyncio.get_running_loop().create_future()
+        waiters = self._commits.setdefault(digest, [])
+        waiters.append(fut)
+        try:
+            return await asyncio.wait_for(fut, timeout_s)
+        finally:
+            # a timed-out waiter must not pin its (cancelled) future
+            if fut in waiters:
+                waiters.remove(fut)
+            if not waiters:
+                self._commits.pop(digest, None)
+
+    async def status(self, timeout_s: float = 10.0) -> dict:
+        self._check_alive()
+        fut = asyncio.get_running_loop().create_future()
+        self._status_waiters.append(fut)
+        async with self._wlock:
+            self._writer.write(framing.encode_frame(
+                framing.STATUS_REQ, b"", self.max_frame
+            ))
+            await self._writer.drain()
+        return await asyncio.wait_for(fut, timeout_s)
+
+    # -- stats ---------------------------------------------------------------
+
+    def latency_percentiles(self) -> Dict[str, float]:
+        return latency_percentiles(lat for _d, lat in self.latencies)
+
+    # -- internals -----------------------------------------------------------
+
+    async def _keepalive_loop(self) -> None:
+        nonce = 0
+        while self._dead is None:
+            await asyncio.sleep(self.keepalive_s)
+            nonce += 1
+            try:
+                async with self._wlock:
+                    self._writer.write(framing.encode_frame(
+                        framing.PING, struct.pack(">Q", nonce),
+                        self.max_frame,
+                    ))
+                    await self._writer.drain()
+            except (ConnectionError, OSError):
+                return  # the recv loop surfaces the death to waiters
+
+    async def _recv_loop(self) -> None:
+        decoder = FrameDecoder(self.max_frame)
+        try:
+            while True:
+                data = await self._reader.read(65536)
+                if not data:
+                    raise ConnectionError("node closed connection")
+                for kind, payload in decoder.feed(data):
+                    self._on_frame(kind, payload)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            # a dead reader must surface NOW on every pending future —
+            # not as N× full submit/commit timeouts later
+            self._fail_waiters(
+                exc if isinstance(exc, ConnectionError)
+                else ConnectionError(f"client receive loop died: {exc!r}")
+            )
+
+    def _on_frame(self, kind: int, payload: bytes) -> None:
+        if kind == framing.TX_ACK:
+            status, digest = payload[0], payload[1:33]
+            fut = self._acks.pop(digest, None)
+            if fut is not None and not fut.done():
+                fut.set_result(status)
+        elif kind == framing.TX_COMMIT:
+            # u64 era + u64 epoch + u32 count + count × 32-byte digests;
+            # nodes broadcast every committed digest to every client, so
+            # only digests we submitted or are awaiting are retained
+            era, epoch, count = struct.unpack_from(">QQI", payload, 0)
+            now = time.monotonic()
+            for i in range(count):
+                digest = payload[20 + 32 * i : 52 + 32 * i]
+                t0 = self._submit_times.pop(digest, None)
+                waiters = self._commits.pop(digest, None)
+                if t0 is None and waiters is None:
+                    continue  # someone else's transaction
+                lat = now - t0 if t0 is not None else 0.0
+                if t0 is not None:
+                    self.latencies.append((digest.hex(), lat))
+                self._committed[digest] = lat
+                while len(self._committed) > self._committed_cap:
+                    self._committed.popitem(last=False)
+                for fut in waiters or ():
+                    if not fut.done():
+                        fut.set_result(lat)
+        elif kind == framing.STATUS:
+            doc = json.loads(payload.decode())
+            waiters, self._status_waiters = self._status_waiters, []
+            for fut in waiters:
+                if not fut.done():
+                    fut.set_result(doc)
+
+    def _check_alive(self) -> None:
+        if self._dead is not None:
+            raise ConnectionError(
+                f"connection to {self.addr} is dead: {self._dead}"
+            )
+
+    def _fail_waiters(self, exc: Exception) -> None:
+        self._dead = exc
+        commit_futs = [
+            fut for waiters in self._commits.values() for fut in waiters
+        ]
+        for fut in (list(self._acks.values()) + commit_futs
+                    + self._status_waiters):
+            if not fut.done():
+                fut.set_exception(exc)

@@ -1,0 +1,314 @@
+"""Cluster assembly: build QHB node runtimes, in-process or as processes.
+
+One :class:`ClusterConfig` (n, seed, ports, batch size, encryption) fully
+determines a cluster: every process derives the same BLS key material from
+``NetworkInfo.generate_map(range(n), Random(seed))``, so nodes need no key
+distribution — the config IS the deployment descriptor for localhost runs.
+
+Two drivers share the builders:
+
+- :class:`LocalCluster` — all runtimes on one asyncio loop with ephemeral
+  ports (real sockets, one process): the fast harness for tests and for
+  ``bench.py --net``'s latency measurements;
+- :func:`spawn_node` / ``python -m hbbft_tpu.net.cluster --node-id I …`` —
+  one OS process per node on ``base_port + i``: the deployment shape, used
+  by ``examples/cluster.py`` and the slow kill/restart e2e test.
+
+``VirtualNet`` remains the deterministic single-process test harness; this
+module is the path that runs the same protocol objects over real TCP.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from hbbft_tpu.net.client import ClusterClient
+from hbbft_tpu.net.runtime import NodeRuntime
+from hbbft_tpu.netinfo import NetworkInfo
+from hbbft_tpu.protocols.dynamic_honey_badger import DynamicHoneyBadger
+from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
+from hbbft_tpu.protocols.queueing_honey_badger import QueueingHoneyBadger
+from hbbft_tpu.protocols.sender_queue import SenderQueue
+
+Addr = Tuple[str, int]
+
+
+@dataclass
+class ClusterConfig:
+    n: int = 4
+    seed: int = 0
+    host: str = "127.0.0.1"
+    base_port: int = 0          # 0 → ephemeral ports (in-process only)
+    batch_size: int = 8
+    encrypt: bool = False       # TPKE-encrypt contributions
+    heartbeat_s: float = 0.5
+    dead_after_s: float = 3.0
+    replay_retain_epochs: int = 64
+
+    @property
+    def cluster_id(self) -> bytes:
+        return b"hbbft-net/%d/%d/%d" % (self.n, self.seed,
+                                        1 if self.encrypt else 0)
+
+    def addr(self, nid: int) -> Addr:
+        if self.base_port == 0:
+            raise ValueError("base_port 0 has no fixed addresses")
+        return (self.host, self.base_port + nid)
+
+    def addr_map(self) -> Dict[int, Addr]:
+        return {nid: self.addr(nid) for nid in range(self.n)}
+
+
+def generate_infos(cfg: ClusterConfig) -> Dict[int, NetworkInfo]:
+    return NetworkInfo.generate_map(
+        list(range(cfg.n)), random.Random(cfg.seed)
+    )
+
+
+def build_algo(cfg: ClusterConfig, infos: Dict[int, NetworkInfo],
+               nid: int) -> SenderQueue:
+    """The standard node stack: SenderQueue(QHB(DHB)) with per-node seeded
+    RNGs derived from the cluster seed (same-seed-same-trace)."""
+    dhb = DynamicHoneyBadger(
+        infos[nid],
+        infos[nid].secret_key(),
+        rng=random.Random(cfg.seed * 100_000 + 7000 + nid),
+        encryption_schedule=(
+            EncryptionSchedule.always() if cfg.encrypt
+            else EncryptionSchedule.never()
+        ),
+    )
+    qhb = QueueingHoneyBadger(
+        dhb, batch_size=cfg.batch_size,
+        rng=random.Random(cfg.seed * 100_000 + 8000 + nid),
+    )
+    return SenderQueue(qhb)
+
+
+def build_runtime(cfg: ClusterConfig, infos: Dict[int, NetworkInfo],
+                  nid: int, **kwargs) -> NodeRuntime:
+    return NodeRuntime(
+        build_algo(cfg, infos, nid),
+        cfg.cluster_id,
+        seed=cfg.seed * 1000 + nid,
+        heartbeat_s=cfg.heartbeat_s,
+        dead_after_s=cfg.dead_after_s,
+        replay_retain_epochs=cfg.replay_retain_epochs,
+        **kwargs,
+    )
+
+
+# -- in-process cluster ------------------------------------------------------
+
+
+class LocalCluster:
+    """All n runtimes on this process's event loop, ephemeral ports."""
+
+    def __init__(self, cfg: ClusterConfig, **runtime_kwargs):
+        self.cfg = cfg
+        self.runtime_kwargs = runtime_kwargs
+        self.runtimes: List[NodeRuntime] = []
+        self.addrs: Dict[int, Addr] = {}
+        self._clients: List[ClusterClient] = []
+
+    async def start(self) -> None:
+        infos = generate_infos(self.cfg)
+        self.runtimes = [
+            build_runtime(self.cfg, infos, nid, **self.runtime_kwargs)
+            for nid in range(self.cfg.n)
+        ]
+        for nid, rt in enumerate(self.runtimes):
+            self.addrs[nid] = await rt.start(self.cfg.host, 0)
+        for rt in self.runtimes:
+            rt.connect(self.addrs)
+
+    async def stop(self) -> None:
+        for client in self._clients:
+            await client.close()
+        for rt in self.runtimes:
+            await rt.stop()
+
+    async def client(self, nid: int,
+                     client_id: str = "client") -> ClusterClient:
+        client = ClusterClient(
+            self.addrs[nid], self.cfg.cluster_id, client_id=client_id
+        )
+        await client.connect()
+        self._clients.append(client)
+        return client
+
+    async def wait_epochs(self, min_batches: int,
+                          timeout_s: float = 60.0) -> None:
+        """Until every runtime has committed ≥ ``min_batches`` batches."""
+
+        async def _wait():
+            while any(
+                len(rt.batches) < min_batches for rt in self.runtimes
+            ):
+                await asyncio.sleep(0.02)
+
+        await asyncio.wait_for(_wait(), timeout_s)
+
+    def common_digest_prefix(self) -> List[str]:
+        """The agreed ledger-digest chain prefix across all runtimes; raises
+        if any node's chain *conflicts* (same index, different digest)."""
+        chains = [rt.digest_chain for rt in self.runtimes]
+        prefix: List[str] = []
+        for i in range(min(len(c) for c in chains)):
+            vals = {c[i] for c in chains}
+            if len(vals) != 1:
+                raise AssertionError(
+                    f"ledger fork at batch {i}: {sorted(vals)}"
+                )
+            prefix.append(chains[0][i])
+        return prefix
+
+
+def assert_status_chains_consistent(docs) -> int:
+    """Every pair of node STATUS documents must agree wherever their
+    ledger digest chains overlap; returns how many indices were checked.
+    The cross-process sibling of :meth:`LocalCluster.common_digest_prefix`.
+    """
+    checked = 0
+    tails = [(d["digest_chain_offset"], d["digest_chain"]) for d in docs]
+    lo = max(off for off, _c in tails)
+    hi = min(off + len(c) for off, c in tails)
+    for i in range(lo, hi):
+        vals = {c[i - off] for off, c in tails}
+        if len(vals) != 1:
+            raise AssertionError(f"ledger fork at batch {i}: {sorted(vals)}")
+        checked += 1
+    return checked
+
+
+# -- multi-process cluster ---------------------------------------------------
+
+
+def find_free_base_port(n: int, lo: int = 23000, hi: int = 52000) -> int:
+    """A base port with n consecutive free TCP ports on localhost."""
+    for base in range(lo, hi, max(n, 1)):
+        socks = []
+        try:
+            for i in range(n):
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port range found")
+
+
+def node_command(cfg: ClusterConfig, nid: int) -> List[str]:
+    cmd = [
+        sys.executable, "-m", "hbbft_tpu.net.cluster",
+        "--nodes", str(cfg.n),
+        "--node-id", str(nid),
+        "--seed", str(cfg.seed),
+        "--base-port", str(cfg.base_port),
+        "--batch-size", str(cfg.batch_size),
+    ]
+    if cfg.encrypt:
+        cmd.append("--encrypt")
+    return cmd
+
+
+def spawn_node(cfg: ClusterConfig, nid: int,
+               **popen_kwargs) -> subprocess.Popen:
+    """One node as a child process (forces the CPU jax backend so node
+    processes never grab an accelerator)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("HBBFT_PLAIN_LADDER", "1")
+    cwd = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    return subprocess.Popen(
+        node_command(cfg, nid), env=env, cwd=cwd, **popen_kwargs
+    )
+
+
+async def connect_when_up(cfg: ClusterConfig, nid: int, *,
+                          client_id: Optional[str] = None,
+                          timeout_s: float = 120.0) -> ClusterClient:
+    """A connected :class:`ClusterClient` for node ``nid``, retrying while
+    the node process boots."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        client = ClusterClient(cfg.addr(nid), cfg.cluster_id,
+                               client_id=client_id or f"client-{nid}")
+        try:
+            await client.connect()
+            return client
+        except (OSError, asyncio.TimeoutError):
+            await client.close()
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"node {nid} never came up")
+            await asyncio.sleep(0.3)
+
+
+def shutdown_procs(procs, timeout_s: float = 15.0) -> None:
+    """SIGTERM every live node process, escalating to SIGKILL."""
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    for p in procs:
+        try:
+            p.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+async def run_node(cfg: ClusterConfig, nid: int) -> None:
+    """Run one node forever (the subprocess entry body)."""
+    infos = generate_infos(cfg)
+    rt = build_runtime(cfg, infos, nid)
+    host, port = cfg.addr(nid)
+    await rt.start(host, port)
+    rt.connect(cfg.addr_map())
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    print(f"node {nid} listening on {host}:{port}", flush=True)
+    await stop.wait()
+    await rt.stop()
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    ap = argparse.ArgumentParser(
+        description="run ONE hbbft-tpu cluster node (see examples/cluster.py "
+                    "for the multi-process launcher)"
+    )
+    ap.add_argument("--nodes", type=int, required=True)
+    ap.add_argument("--node-id", type=int, required=True)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--base-port", type=int, required=True)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--encrypt", action="store_true")
+    args = ap.parse_args(argv)
+    if not 0 <= args.node_id < args.nodes:
+        ap.error(f"--node-id {args.node_id} not in 0..{args.nodes - 1}")
+    cfg = ClusterConfig(
+        n=args.nodes, seed=args.seed, base_port=args.base_port,
+        batch_size=args.batch_size, encrypt=args.encrypt,
+    )
+    asyncio.run(run_node(cfg, args.node_id))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,181 @@
+"""Length-prefixed frames over the wire codec, plus the versioned hello.
+
+The sans-I/O stack speaks :mod:`hbbft_tpu.protocols.wire` message bytes;
+this module wraps those bytes (and the small set of runtime control
+payloads) into self-delimiting TCP frames:
+
+    u32 length | u8 kind | payload            (length = 1 + len(payload))
+
+Every decode path is capped: a frame claiming more than ``max_frame`` bytes
+is a loud :class:`FrameError` before any allocation happens, and a cut
+stream simply stays pending — :class:`FrameDecoder` never yields a partial
+frame.  The first frame on every connection must be a :data:`HELLO` whose
+payload carries magic, protocol version, the sender's role and id, its
+current (era, epoch), and the cluster id; any mismatch kills the
+connection before a single protocol message is parsed.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Hashable, List, Tuple
+
+from hbbft_tpu.protocols import wire
+
+MAGIC = b"HBTN"
+PROTOCOL_VERSION = 1
+
+# Frame cap: one frame carries at most one wire message (itself capped at
+# wire.MAX_MESSAGE_BYTES) plus the kind byte; the hello/control frames are
+# tiny.  Kept as a parameter everywhere so tests can shrink it.
+DEFAULT_MAX_FRAME = wire.MAX_MESSAGE_BYTES + 1
+
+# -- frame kinds -------------------------------------------------------------
+
+HELLO = 0x01       # versioned handshake; first frame both ways
+MSG = 0x02         # consensus payload: wire.encode_message bytes
+PING = 0x03        # heartbeat, u64 nonce
+PONG = 0x04        # heartbeat echo
+TX = 0x05          # client → node: raw transaction bytes
+TX_ACK = 0x06      # node → client: u8 status + 32-byte tx digest
+TX_COMMIT = 0x07   # node → client: era/epoch + committed tx digests
+STATUS_REQ = 0x08  # client → node: empty
+STATUS = 0x09      # node → client: JSON status document
+
+KIND_NAMES = {
+    HELLO: "HELLO", MSG: "MSG", PING: "PING", PONG: "PONG", TX: "TX",
+    TX_ACK: "TX_ACK", TX_COMMIT: "TX_COMMIT", STATUS_REQ: "STATUS_REQ",
+    STATUS: "STATUS",
+}
+
+# TX_ACK status bytes
+ACK_ACCEPTED = 0
+ACK_DUPLICATE = 1
+ACK_FULL = 2       # backpressure: retry later
+ACK_REJECTED = 3   # oversized: never retry
+
+ROLE_NODE = 0x01
+ROLE_CLIENT = 0x02
+
+
+class FrameError(ValueError):
+    """Malformed, oversized, or protocol-violating frame data."""
+
+
+def encode_frame(kind: int, payload: bytes,
+                 max_frame: int = DEFAULT_MAX_FRAME) -> bytes:
+    body_len = 1 + len(payload)
+    if body_len > max_frame:
+        raise FrameError(
+            f"frame of {body_len} bytes exceeds cap {max_frame}"
+        )
+    return struct.pack(">IB", body_len, kind) + payload
+
+
+class FrameDecoder:
+    """Incremental frame parser: ``feed`` bytes, get complete frames.
+
+    Holds at most one partial frame; enforces the size cap on the *claimed*
+    length, so a hostile 4 GiB prefix is rejected before buffering."""
+
+    def __init__(self, max_frame: int = DEFAULT_MAX_FRAME):
+        self.max_frame = max_frame
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[Tuple[int, bytes]]:
+        self._buf.extend(data)
+        frames: List[Tuple[int, bytes]] = []
+        while True:
+            if len(self._buf) < 4:
+                return frames
+            (body_len,) = struct.unpack_from(">I", self._buf, 0)
+            if body_len < 1:
+                raise FrameError("zero-length frame body")
+            if body_len > self.max_frame:
+                raise FrameError(
+                    f"frame of {body_len} bytes exceeds cap {self.max_frame}"
+                )
+            if len(self._buf) < 4 + body_len:
+                return frames
+            kind = self._buf[4]
+            payload = bytes(self._buf[5 : 4 + body_len])
+            del self._buf[: 4 + body_len]
+            frames.append((kind, payload))
+
+    def pending(self) -> int:
+        """Bytes buffered awaiting a complete frame."""
+        return len(self._buf)
+
+
+async def read_one_frame(reader, max_frame: int = DEFAULT_MAX_FRAME
+                         ) -> Tuple[int, bytes]:
+    """Read exactly one frame from an ``asyncio.StreamReader`` — the
+    handshake-time sibling of :class:`FrameDecoder` (used before a
+    connection's steady-state decode loop starts)."""
+    header = await reader.readexactly(4)
+    (body_len,) = struct.unpack(">I", header)
+    if body_len < 1 or body_len > max_frame:
+        raise FrameError(
+            f"frame of {body_len} bytes outside (0, {max_frame}]"
+        )
+    body = await reader.readexactly(body_len)
+    return body[0], body[1:]
+
+
+# -- hello -------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Hello:
+    node_id: Hashable           # node id, or a client token string
+    role: int                   # ROLE_NODE | ROLE_CLIENT
+    cluster_id: bytes           # must match on both ends
+    era: int                    # sender's current (era, epoch) — the
+    epoch: int                  # SenderQueue resume key
+
+    @property
+    def key(self) -> Tuple[int, int]:
+        return (self.era, self.epoch)
+
+
+def encode_hello(h: Hello) -> bytes:
+    if h.role not in (ROLE_NODE, ROLE_CLIENT):
+        raise FrameError(f"bad hello role {h.role}")
+    return (
+        MAGIC
+        + wire.u32(PROTOCOL_VERSION)
+        + bytes([h.role])
+        + wire.node_id(h.node_id)
+        + wire.u64(h.era)
+        + wire.u64(h.epoch)
+        + wire.blob(h.cluster_id)
+    )
+
+
+def decode_hello(payload: bytes) -> Hello:
+    r = wire.Reader(payload)
+    try:
+        if r.take(4) != MAGIC:
+            raise FrameError("bad hello magic")
+        version = r.u32()
+        if version != PROTOCOL_VERSION:
+            raise FrameError(
+                f"hello version mismatch: peer speaks {version}, "
+                f"we speak {PROTOCOL_VERSION}"
+            )
+        role = r.take(1)[0]
+        if role not in (ROLE_NODE, ROLE_CLIENT):
+            raise FrameError(f"bad hello role {role}")
+        node_id = wire.read_node_id(r)
+        era = r.u64()
+        epoch = r.u64()
+        cluster_id = r.blob()
+        if not r.done():
+            raise FrameError("trailing bytes after hello")
+    except ValueError as exc:  # wire truncation/caps → FrameError
+        if isinstance(exc, FrameError):
+            raise
+        raise FrameError(f"malformed hello: {exc}") from exc
+    return Hello(node_id=node_id, role=role, cluster_id=cluster_id,
+                 era=era, epoch=epoch)

@@ -1,0 +1,370 @@
+"""NodeRuntime: host a sans-I/O consensus protocol on real sockets.
+
+The runtime owns the event loop the :class:`~hbbft_tpu.traits.Step`
+contract demands: it feeds received wire bytes into a
+:class:`~hbbft_tpu.protocols.sender_queue.SenderQueue`-wrapped algorithm
+(QHB/DHB/HB — anything ``SenderQueue`` can wrap), resolves each outgoing
+``Target::All/AllExcept/Node`` against the transport's peer set, and
+encodes every message exactly once per payload.
+
+Catch-up (the ``EpochStarted`` path):
+
+- every connection hello carries the sender's current (era, epoch);
+- a hello *above* a peer's recorded key is fed to the SenderQueue as a
+  normal ``EpochStarted`` (releasing held-back messages);
+- a hello *below* it means the peer restarted: the runtime rewinds the
+  SenderQueue via :meth:`SenderQueue.reinit_peer`, handing it the replay
+  log of recently-sent (key, message) pairs it retains per peer.  The
+  restarted peer then replays the protocol from its announced key, with
+  the backlog flowing in epoch order as it announces progress — a node
+  restarted from scratch at (0, 0) recovers every batch as long as the
+  replay retention covers the history.
+
+Client traffic (``TX``/``STATUS_REQ`` frames) is admitted through a
+bounded dedup'd :class:`~hbbft_tpu.net.client.Mempool` — the backpressure
+boundary — and committed batches are pushed back to every connected client
+as ``TX_COMMIT`` digests, which is what the client's latency measurement
+keys on.  A running SHA3 chain over committed batches (``ledger digest``)
+makes cross-node batch-identity a one-line comparison.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import struct
+from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
+
+from hbbft_tpu.net import framing
+from hbbft_tpu.net.client import Mempool, tx_digest
+from hbbft_tpu.net.transport import ClientConn, Transport
+from hbbft_tpu.protocols import wire
+from hbbft_tpu.protocols.dynamic_honey_badger import DhbBatch
+from hbbft_tpu.protocols.honey_badger import Batch as HbBatch
+from hbbft_tpu.protocols.queueing_honey_badger import QhbBatch, TxInput
+from hbbft_tpu.protocols.sender_queue import (
+    AlgoMessage,
+    EpochStarted,
+    SenderQueue,
+    _algo_key,
+    _algo_window,
+    message_key,
+)
+from hbbft_tpu.traits import Step
+
+NodeId = Hashable
+EpochKey = Tuple[int, int]
+Addr = Tuple[str, int]
+
+logger = logging.getLogger("hbbft_tpu.net")
+
+
+def _change_state_bytes(cs: Any) -> bytes:
+    """The batch's validator-set change decision is consensus output too —
+    a fork in DKG/membership state must show in the ledger digest."""
+    out = wire.blob(cs.state.encode())
+    out += cs.change.to_bytes() if cs.change is not None else b"\x00"
+    return out
+
+
+def _batch_bytes(b: Any) -> bytes:
+    """Canonical bytes of a committed batch for the ledger digest chain."""
+    if isinstance(b, QhbBatch):
+        out = b"qhb" + wire.u64(b.era) + wire.u64(b.epoch)
+        for proposer, txs in b.contributions:
+            out += wire.node_id(proposer) + wire.u32(len(txs))
+            for tx in txs:
+                out += wire.blob(tx)
+        return out + _change_state_bytes(b.change)
+    if isinstance(b, DhbBatch):
+        out = b"dhb" + wire.u64(b.era) + wire.u64(b.epoch)
+        for proposer, payload in b.contributions:
+            out += wire.node_id(proposer) + wire.blob(payload)
+        return out + _change_state_bytes(b.change)
+    if isinstance(b, HbBatch):
+        out = b"hb" + wire.u64(b.epoch)
+        for proposer, payload in b.contributions:
+            out += wire.node_id(proposer) + wire.blob(payload)
+        return out
+    raise TypeError(f"unknown batch type {type(b).__name__}")
+
+
+class NodeRuntime:
+    """One networked consensus node: SenderQueue-wrapped algorithm +
+    :class:`Transport` + client admission."""
+
+    def __init__(
+        self,
+        algo: Any,
+        cluster_id: bytes,
+        *,
+        seed: int = 0,
+        mempool: Optional[Mempool] = None,
+        make_tx_input: Callable[[bytes], Any] = TxInput,
+        replay_retain_epochs: int = 64,
+        on_batch: Optional[Callable[[Any], None]] = None,
+        trace=None,
+        cost_model=None,
+        **transport_kwargs,
+    ):
+        self.sq = algo if isinstance(algo, SenderQueue) else SenderQueue(algo)
+        self.mempool = mempool or Mempool()
+        # the oversized-frame drop in _dispatch is a last-resort guard,
+        # not a config escape hatch: a proposal of batch_size max-size txs
+        # must fit the wire blob cap with margin (TLV + TPKE overhead),
+        # or an honest proposer could wedge its own epochs
+        batch_size = getattr(self.sq.algo, "batch_size", None)
+        if batch_size is not None:
+            worst = batch_size * (self.mempool.max_tx_bytes + 16)
+            if worst > wire.MAX_BLOB_BYTES // 2:
+                raise ValueError(
+                    f"batch_size {batch_size} × max_tx_bytes "
+                    f"{self.mempool.max_tx_bytes} = {worst}B can exceed "
+                    f"half the wire blob cap ({wire.MAX_BLOB_BYTES}B): "
+                    f"lower one of them (Mempool(max_tx_bytes=…))"
+                )
+        self.make_tx_input = make_tx_input
+        self.replay_retain_epochs = replay_retain_epochs
+        self.on_batch = on_batch
+        self.batches: List[Any] = []
+        self.ledger_digest = b"\x00" * 32
+        self.digest_chain: List[str] = []
+        self.committed_txs = 0
+        self.decode_failures = 0
+        self.send_failures = 0
+        self.replay_gaps = 0
+        self.faults_observed = 0
+        # per-peer replay log of recently sent consensus messages, in send
+        # order: the reinit_peer history (see module docstring).  The
+        # companion set dedups by value so reinit re-sends don't duplicate
+        # the log (protocol messages are frozen dataclasses — hashable)
+        self._replay: Dict[NodeId, List[Tuple[EpochKey, Any]]] = {}
+        self._replay_seen: Dict[NodeId, set] = {}
+        self._clients: set = set()
+        self.transport = Transport(
+            our_id=self.sq.our_id(),
+            cluster_id=cluster_id,
+            seed=seed,
+            hello_key=self.current_key,
+            on_peer_message=self._on_peer_message,
+            on_peer_hello=self._on_peer_hello,
+            on_client_frame=self._on_client_frame,
+            on_client_gone=self._on_client_gone,
+            trace=trace,
+            cost_model=cost_model,
+            **transport_kwargs,
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def our_id(self) -> NodeId:
+        return self.sq.our_id()
+
+    def current_key(self) -> EpochKey:
+        return _algo_key(self.sq.algo)
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
+        return await self.transport.listen(host, port)
+
+    def connect(self, peer_addrs: Dict[NodeId, Addr]) -> None:
+        """Add peers and announce our epoch (SenderQueue startup)."""
+        for peer_id, addr in peer_addrs.items():
+            if peer_id != self.our_id():
+                self.transport.add_peer(peer_id, addr)
+        self._absorb(self.sq.startup_step())
+
+    async def stop(self) -> None:
+        await self.transport.stop()
+
+    # -- consensus plumbing --------------------------------------------------
+
+    def submit_tx(self, tx: bytes) -> int:
+        """Local admission (same path as a client TX frame)."""
+        status = self.mempool.add(tx)
+        if status == Mempool.ACCEPTED:
+            self._absorb(self.sq.handle_input(self.make_tx_input(tx)))
+        return status
+
+    def _on_peer_message(self, peer_id: NodeId, payload: bytes) -> None:
+        try:
+            msg = wire.decode_message(payload)
+        except ValueError as exc:
+            self.decode_failures += 1
+            logger.warning("undecodable message from %r: %s", peer_id, exc)
+            return
+        if not isinstance(msg, (AlgoMessage, EpochStarted)):
+            self.decode_failures += 1
+            logger.warning("non-sender-queue message %s from %r",
+                           type(msg).__name__, peer_id)
+            return
+        try:
+            step = self.sq.handle_message(peer_id, msg)
+        except TypeError as exc:
+            # decodable but protocol-unexpected (e.g. AlgoMessage wrapping
+            # a bare ReadyMsg): Byzantine input at the network boundary —
+            # count it, keep the connection and the loop alive
+            self.decode_failures += 1
+            logger.warning("protocol-rejected message from %r: %s",
+                           peer_id, exc)
+            return
+        self._absorb(step)
+
+    def _on_peer_hello(self, peer_id: NodeId, hello, direction: str) -> None:
+        # A hello means a (re)connection: whatever we previously drained
+        # into a socket for this peer may have died in TCP buffers, and a
+        # below-record key means it restarted outright (possibly from
+        # (0, 0)).  At-least-once, uniformly: (re)set its sender-queue
+        # record to the announced key and replay the retained log from
+        # there — entries below the key are obsolete at the peer, resent
+        # duplicates above it are protocol no-ops.  On a clean first
+        # connect the log is empty and this degrades to registering the
+        # peer and exchanging EpochStarted.
+        key = hello.key
+        cur = self.sq.peer_epochs.get(peer_id)
+        history = [
+            e for e in self._replay.get(peer_id, []) if e[0] >= key
+        ]
+        if history or (cur is not None and key < cur):
+            logger.info("peer %r reconnected at %r (recorded %r): "
+                        "replaying %d retained messages through the "
+                        "sender queue", peer_id, key, cur, len(history))
+        # retention check: if the oldest retained entry is already beyond
+        # the peer's delivery window, nothing we replay is deliverable and
+        # the peer can never announce progress — it is wedged, not merely
+        # catching up.  Surface that loudly instead of stalling silently
+        # (remedy: restart the peer from a snapshot, or raise
+        # replay_retain_epochs).
+        window = _algo_window(self.sq.algo)
+        if history and min(e[0] for e in history) > (key[0],
+                                                     key[1] + window):
+            self.replay_gaps += 1
+            logger.error(
+                "peer %r announced %r but the replay log only reaches "
+                "back to %r (> window %d): retention does not cover its "
+                "gap; it cannot catch up from here",
+                peer_id, key, min(e[0] for e in history), window,
+            )
+        self._absorb(self.sq.reinit_peer(peer_id, key, history))
+
+    def _absorb(self, step: Step) -> None:
+        self.faults_observed += len(step.fault_log)
+        for out in step.output:
+            if isinstance(out, (QhbBatch, DhbBatch, HbBatch)):
+                self._on_batch(out)
+        self._dispatch(step)
+
+    def _dispatch(self, step: Step) -> None:
+        our = self.our_id()
+        peer_ids = self.transport.peer_ids()
+        all_ids = peer_ids + [our]
+        for tm in step.messages:
+            payload = wire.encode_message(tm.message)
+            key = (
+                message_key(tm.message.msg)
+                if isinstance(tm.message, AlgoMessage) else None
+            )
+            for dest in tm.target.resolve(all_ids, our):
+                try:
+                    self.transport.send(dest, payload)
+                except framing.FrameError as exc:
+                    # an oversized frame must not abort the rest of the
+                    # Step's fan-out (the mempool's max_tx_bytes admission
+                    # bound makes this unreachable for honest configs)
+                    self.send_failures += 1
+                    logger.error("dropping oversized frame for %r: %s",
+                                 dest, exc)
+                    break  # same payload, same cap: skip remaining dests
+                if key is not None:
+                    entry = (key, tm.message.msg)
+                    seen = self._replay_seen.setdefault(dest, set())
+                    if entry not in seen:
+                        seen.add(entry)
+                        self._replay.setdefault(dest, []).append(entry)
+        self._prune_replay()
+
+    def _prune_replay(self) -> None:
+        era, epoch = self.current_key()
+        if epoch >= self.replay_retain_epochs:
+            floor = (era, epoch - self.replay_retain_epochs)
+        else:
+            # young era: a naive (era, epoch−retain) floor would discard
+            # the ENTIRE previous era the instant a DKG rotation lands,
+            # breaking replay for a peer whose outage spans the boundary.
+            # Keep the previous era's tail (itself already pruned to its
+            # last `retain` epochs while that era was current) until this
+            # era is `retain` epochs old.
+            floor = (era - 1, 0) if era > 0 else (0, 0)
+        for dest, entries in self._replay.items():
+            if entries and entries[0][0] < floor:
+                kept = [e for e in entries if e[0] >= floor]
+                self._replay[dest] = kept
+                self._replay_seen[dest] = set(kept)
+
+    # -- batches & clients ---------------------------------------------------
+
+    def _on_batch(self, batch: Any) -> None:
+        self.batches.append(batch)
+        self.ledger_digest = hashlib.sha3_256(
+            self.ledger_digest + _batch_bytes(batch)
+        ).digest()
+        self.digest_chain.append(self.ledger_digest.hex())
+        if isinstance(batch, QhbBatch):
+            txs = batch.all_txs()
+            self.committed_txs += len(txs)
+            digests = self.mempool.mark_committed(txs)
+            self._notify_commit(batch.era, batch.epoch, digests)
+        if self.on_batch is not None:
+            self.on_batch(batch)
+
+    def _notify_commit(self, era: int, epoch: int,
+                       digests: List[bytes]) -> None:
+        if not self._clients or not digests:
+            return
+        payload = struct.pack(">QQI", era, epoch, len(digests)) + b"".join(
+            digests
+        )
+        for conn in list(self._clients):
+            conn.send(framing.TX_COMMIT, payload)
+            if conn.closed:
+                self._clients.discard(conn)
+
+    def _on_client_frame(self, conn: ClientConn, kind: int,
+                         payload: bytes) -> None:
+        self._clients.add(conn)
+        if kind == framing.TX:
+            status = self.mempool.add(payload)
+            conn.send(framing.TX_ACK, bytes([status]) + tx_digest(payload))
+            if status == Mempool.ACCEPTED:
+                self._absorb(self.sq.handle_input(self.make_tx_input(payload)))
+        elif kind == framing.STATUS_REQ:
+            conn.send(framing.STATUS, json.dumps(self.status_doc()).encode())
+        else:
+            logger.warning("unknown client frame kind %d", kind)
+
+    def _on_client_gone(self, conn: ClientConn) -> None:
+        self._clients.discard(conn)
+
+    def status_doc(self, chain_tail: int = 256) -> dict:
+        era, epoch = self.current_key()
+        offset = max(0, len(self.digest_chain) - chain_tail)
+        return {
+            "node": repr(self.our_id()),
+            "era": era,
+            "epoch": epoch,
+            "batches": len(self.batches),
+            "ledger": self.ledger_digest.hex(),
+            "digest_chain": self.digest_chain[offset:],
+            "digest_chain_offset": offset,
+            "committed_txs": self.committed_txs,
+            "mempool": len(self.mempool),
+            "decode_failures": self.decode_failures,
+            "send_failures": self.send_failures,
+            "replay_gaps": self.replay_gaps,
+            "faults_observed": self.faults_observed,
+            "peers_connected": sum(
+                1 for p in self.transport.peer_ids()
+                if self.transport.connected(p)
+            ),
+            "stats": self.transport.stats.as_dict(),
+        }

@@ -1,0 +1,615 @@
+"""Asyncio TCP transport: peer connections, backoff, heartbeats.
+
+Connection topology: every node keeps ONE outbound connection per peer used
+exclusively for sending (consensus ``MSG`` frames + ``PING`` heartbeats;
+the acceptor answers ``PONG`` on the same socket), and accepts inbound
+connections for receiving.  Send/receive asymmetry means there is no
+connection-dedup race: a (dialer, acceptor) pair owns each socket.
+
+Reliability model:
+
+- per-peer outbound queues are *persistent across reconnects*: frames
+  enqueued while a peer is down are delivered, in order, once it is back
+  (at-least-once — a frame written into a socket that dies mid-flight is
+  re-sent, and the consensus protocols treat duplicates as no-ops/logged
+  faults);
+- reconnects use seeded exponential backoff with jitter: with a fixed
+  ``seed`` the drawn delay sequence is identical run to run (the
+  same-seed-same-trace property the simulator guarantees extends to the
+  transport's schedule), and every drawn delay is recorded in
+  ``stats.backoff_delays`` so tests can assert it;
+- a dialer that misses heartbeat ``PONG``\\ s for ``dead_after_s`` declares
+  the peer dead, tears the socket down, and re-enters backoff.
+
+Inbound connections announce themselves with the versioned hello
+(:mod:`hbbft_tpu.net.framing`); node-role hellos from ids outside the
+configured peer set, cluster-id mismatches, and version mismatches are
+rejected before any payload frame is parsed.  Client-role connections are
+handed to the runtime via ``on_client_frame``.
+
+SECURITY MODEL — the hello is identification, NOT authentication: node
+ids are self-declared and the cluster id derives from public config, so
+anyone who can reach a node's port can claim any validator identity and
+inject consensus messages attributed to it.  This mirrors the reference
+library's boundary (hbbft assumes authenticated point-to-point channels
+and leaves providing them to the embedder); run clusters only on trusted
+networks (localhost, a private fabric) or wrap the sockets in an
+authenticating layer (TLS/mTLS, WireGuard, or per-peer MACs keyed from
+``NetworkInfo``'s keypairs) before exposing a port.  The per-node Ed/BLS
+signatures INSIDE the protocol (DHB votes, key-gen messages, threshold
+shares) remain verified regardless.
+
+All callbacks run on the event loop; they may call :meth:`Transport.send`
+re-entrantly (it only enqueues).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import random
+import struct
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Deque, Dict, Hashable, List, Optional, Tuple
+
+from hbbft_tpu.net import framing
+from hbbft_tpu.net.framing import (
+    DEFAULT_MAX_FRAME,
+    FrameDecoder,
+    FrameError,
+    Hello,
+    ROLE_CLIENT,
+    ROLE_NODE,
+)
+
+NodeId = Hashable
+Addr = Tuple[str, int]
+
+logger = logging.getLogger("hbbft_tpu.net")
+
+
+class BackoffPolicy:
+    """Seeded exponential backoff with jitter — deterministic per seed.
+
+    ``delays(peer_key)`` yields ``min(cap, base·factor^i) · u`` where ``u``
+    is drawn uniformly from ``[1−jitter, 1)`` by a per-(seed, peer) RNG.
+    The RNG stream is owned by the caller via :meth:`rng_for` so that
+    successive outages continue one deterministic sequence.
+    """
+
+    def __init__(self, seed: int = 0, base: float = 0.05,
+                 factor: float = 2.0, cap: float = 2.0,
+                 jitter: float = 0.5):
+        if not 0.0 <= jitter < 1.0:
+            raise ValueError("jitter must be in [0, 1)")
+        self.seed = seed
+        self.base = base
+        self.factor = factor
+        self.cap = cap
+        self.jitter = jitter
+
+    def rng_for(self, peer_key: str) -> random.Random:
+        digest = hashlib.sha3_256(
+            b"hbbft-net-backoff:%d:%s" % (self.seed, peer_key.encode())
+        ).digest()
+        return random.Random(int.from_bytes(digest[:8], "big"))
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        raw = min(self.cap, self.base * self.factor ** attempt)
+        return raw * (1.0 - self.jitter + self.jitter * rng.random())
+
+    def preview(self, peer_key: str, n: int) -> List[float]:
+        """First ``n`` delays of a fresh stream (for tests/debugging)."""
+        rng = self.rng_for(peer_key)
+        return [self.delay(i, rng) for i in range(n)]
+
+
+@dataclass
+class TransportStats:
+    frames_sent: int = 0
+    bytes_sent: int = 0
+    frames_recv: int = 0
+    bytes_recv: int = 0
+    reconnects: Dict[NodeId, int] = field(default_factory=dict)
+    backoff_delays: Dict[NodeId, List[float]] = field(default_factory=dict)
+    send_queue_peak: int = 0
+    dead_peer_events: int = 0
+    # virtual cost of received traffic under the attached CostModel — the
+    # simulator's synthetic clock applied to real frames, so sim and net
+    # runs report comparable virtual time
+    virtual_cost_s: float = 0.0
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "frames_sent": self.frames_sent,
+            "bytes_sent": self.bytes_sent,
+            "frames_recv": self.frames_recv,
+            "bytes_recv": self.bytes_recv,
+            "reconnects": {repr(k): v for k, v in self.reconnects.items()},
+            "send_queue_peak": self.send_queue_peak,
+            "dead_peer_events": self.dead_peer_events,
+            "virtual_cost_s": round(self.virtual_cost_s, 6),
+        }
+
+
+class ClientConn:
+    """One inbound client-role connection.
+
+    Writes are fire-and-forget but bounded: a client that stops reading
+    its socket would otherwise make the node buffer commit notifications
+    without limit, so once the transport's write buffer exceeds
+    ``MAX_WRITE_BUFFER`` the connection is declared dead and dropped (the
+    client can reconnect; commit state is queryable via STATUS_REQ)."""
+
+    MAX_WRITE_BUFFER = 1 << 20
+
+    _next = 0
+
+    def __init__(self, hello: Hello, writer: asyncio.StreamWriter,
+                 max_frame: int, record_send=None):
+        ClientConn._next += 1
+        self.conn_id = ClientConn._next
+        self.hello = hello
+        self.client_id = hello.node_id
+        self._writer = writer
+        self._max_frame = max_frame
+        self._record_send = record_send
+        self.closed = False
+
+    def send(self, kind: int, payload: bytes) -> None:
+        if self.closed:
+            return
+        try:
+            if (self._writer.transport.get_write_buffer_size()
+                    > self.MAX_WRITE_BUFFER):
+                self.closed = True
+                self._writer.close()
+                return
+            frame = framing.encode_frame(kind, payload, self._max_frame)
+            self._writer.write(frame)
+            if self._record_send is not None:
+                self._record_send(self.client_id, frame)
+        except (ConnectionError, RuntimeError):
+            self.closed = True
+
+
+class _PeerSender:
+    """Outbound half for one peer: queue + dial/backoff/heartbeat loop."""
+
+    def __init__(self, transport: "Transport", peer_id: NodeId, addr: Addr):
+        self.t = transport
+        self.peer_id = peer_id
+        self.addr = addr
+        self.outbox: Deque[bytes] = deque()
+        self.wake = asyncio.Event()
+        self.connected = asyncio.Event()
+        self.stopped = False
+        self.rng = transport.backoff.rng_for(
+            f"{transport.our_id!r}->{peer_id!r}"
+        )
+        self.task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self.task = asyncio.get_running_loop().create_task(
+            self._run(), name=f"peer-sender-{self.peer_id!r}"
+        )
+
+    def send(self, frame: bytes) -> None:
+        self.outbox.append(frame)
+        peak = len(self.outbox)
+        if peak > self.t.stats.send_queue_peak:
+            self.t.stats.send_queue_peak = peak
+        self.wake.set()
+
+    async def _run(self) -> None:
+        attempt = 0
+        while not self.stopped:
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(*self.addr),
+                    self.t.connect_timeout_s,
+                )
+            except (OSError, asyncio.TimeoutError):
+                attempt = await self._backoff(attempt)
+                continue
+            try:
+                hello = await self._handshake(reader, writer)
+            except (OSError, asyncio.TimeoutError, FrameError,
+                    asyncio.IncompleteReadError) as exc:
+                logger.debug("handshake with %r failed: %r",
+                             self.peer_id, exc)
+                writer.close()
+                attempt = await self._backoff(attempt)
+                continue
+            self.connected.set()
+            self.t._notify_hello(self.peer_id, hello, direction="dial")
+            t_conn = time.monotonic()
+            try:
+                await self._serve(reader, writer)
+            finally:
+                self.connected.clear()
+                writer.close()
+                if not self.stopped:
+                    self.t.stats.reconnects[self.peer_id] = (
+                        self.t.stats.reconnects.get(self.peer_id, 0) + 1
+                    )
+            # a connection that survived a while earns an immediate redial
+            # with reset growth; one that died right after the handshake
+            # keeps climbing the backoff ladder — otherwise a peer that
+            # kills every fresh connection induces a zero-delay dial spin
+            if time.monotonic() - t_conn >= self.t.dead_after_s:
+                attempt = 0
+            else:
+                attempt = await self._backoff(attempt)
+
+    async def _backoff(self, attempt: int) -> int:
+        delay = self.t.backoff.delay(attempt, self.rng)
+        self.t.stats.backoff_delays.setdefault(self.peer_id, []).append(delay)
+        await asyncio.sleep(delay)
+        return attempt + 1
+
+    async def _handshake(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> Hello:
+        frame = framing.encode_frame(
+            framing.HELLO, framing.encode_hello(self.t.local_hello()),
+            self.t.max_frame,
+        )
+        writer.write(frame)
+        await writer.drain()
+        self.t._record_send(self.peer_id, frame)
+        kind, payload = await asyncio.wait_for(
+            framing.read_one_frame(reader, self.t.max_frame),
+            self.t.dead_after_s,
+        )
+        if kind != framing.HELLO:
+            raise FrameError(f"expected HELLO reply, got kind {kind}")
+        hello = framing.decode_hello(payload)
+        if hello.cluster_id != self.t.cluster_id:
+            raise FrameError("cluster id mismatch")
+        if hello.role != ROLE_NODE or hello.node_id != self.peer_id:
+            raise FrameError(
+                f"dialed {self.peer_id!r}, got hello from "
+                f"{hello.node_id!r}"
+            )
+        return hello
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        """Drain the outbox + heartbeat until the connection dies."""
+        last_pong = time.monotonic()
+        ping_nonce = 0
+        # drainer and heartbeat share the StreamWriter; two tasks awaiting
+        # writer.drain() concurrently trip asyncio's _drain_helper assert
+        # under write backpressure, so every write+drain takes this lock
+        wlock = asyncio.Lock()
+
+        async def pong_reader():
+            nonlocal last_pong
+            decoder = FrameDecoder(self.t.max_frame)
+            while True:
+                data = await reader.read(65536)
+                if not data:
+                    return
+                for kind, _payload in decoder.feed(data):
+                    if kind == framing.PONG:
+                        last_pong = time.monotonic()
+                    else:
+                        raise FrameError(
+                            f"unexpected frame kind {kind} on send socket"
+                        )
+
+        async def drainer():
+            while True:
+                await self.wake.wait()
+                self.wake.clear()
+                while self.outbox:
+                    frame = self.outbox[0]
+                    async with wlock:
+                        writer.write(frame)
+                        await writer.drain()
+                    # popped only after a successful drain: a frame in
+                    # flight when the socket dies is re-sent (at-least-once)
+                    self.outbox.popleft()
+                    self.t._record_send(self.peer_id, frame)
+
+        async def ping_once():
+            frame = framing.encode_frame(
+                framing.PING, struct.pack(">Q", ping_nonce),
+                self.t.max_frame,
+            )
+            async with wlock:
+                writer.write(frame)
+                await writer.drain()
+            self.t._record_send(self.peer_id, frame)
+
+        async def heartbeat():
+            nonlocal ping_nonce
+            while True:
+                await asyncio.sleep(self.t.heartbeat_s)
+                # deadline check runs UNLOCKED every cycle: when the peer
+                # stops reading, the drainer wedges inside writer.drain()
+                # holding wlock — the ping below must not be allowed to
+                # park this task behind it, or dead-peer detection would
+                # never fire and the connection would hang forever
+                if time.monotonic() - last_pong > self.t.dead_after_s:
+                    self.t.stats.dead_peer_events += 1
+                    raise ConnectionError(
+                        f"peer {self.peer_id!r} missed heartbeats for "
+                        f"{self.t.dead_after_s}s"
+                    )
+                ping_nonce += 1
+                try:
+                    await asyncio.wait_for(ping_once(), self.t.heartbeat_s)
+                except asyncio.TimeoutError:
+                    pass  # writer congested; the pong deadline decides
+
+        self.wake.set()  # flush anything queued while disconnected
+        tasks = [
+            asyncio.get_running_loop().create_task(c())
+            for c in (pong_reader, drainer, heartbeat)
+        ]
+        try:
+            done, _pending = await asyncio.wait(
+                tasks, return_when=asyncio.FIRST_COMPLETED
+            )
+            for d in done:
+                exc = d.exception()
+                if exc is not None:
+                    logger.debug("connection to %r dropped: %r",
+                                 self.peer_id, exc)
+        finally:
+            for task in tasks:
+                task.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    async def stop(self) -> None:
+        self.stopped = True
+        if self.task is not None:
+            self.task.cancel()
+            try:
+                await self.task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+
+class Transport:
+    """The node's socket layer: one listener + one sender per peer."""
+
+    def __init__(
+        self,
+        our_id: NodeId,
+        cluster_id: bytes,
+        *,
+        seed: int = 0,
+        hello_key: Callable[[], Tuple[int, int]] = lambda: (0, 0),
+        on_peer_message: Optional[Callable[[NodeId, bytes], None]] = None,
+        on_peer_hello: Optional[
+            Callable[[NodeId, Hello, str], None]
+        ] = None,
+        on_client_frame: Optional[
+            Callable[[ClientConn, int, bytes], None]
+        ] = None,
+        on_client_gone: Optional[Callable[[ClientConn], None]] = None,
+        heartbeat_s: float = 0.5,
+        dead_after_s: float = 3.0,
+        connect_timeout_s: float = 2.0,
+        client_idle_timeout_s: float = 60.0,
+        max_frame: int = DEFAULT_MAX_FRAME,
+        backoff: Optional[BackoffPolicy] = None,
+        trace=None,
+        cost_model=None,
+    ):
+        self.our_id = our_id
+        self.cluster_id = bytes(cluster_id)
+        self.hello_key = hello_key
+        self.on_peer_message = on_peer_message
+        self.on_peer_hello = on_peer_hello
+        self.on_client_frame = on_client_frame
+        self.on_client_gone = on_client_gone
+        self.heartbeat_s = heartbeat_s
+        self.dead_after_s = dead_after_s
+        self.connect_timeout_s = connect_timeout_s
+        self.client_idle_timeout_s = client_idle_timeout_s
+        self.max_frame = max_frame
+        self.backoff = backoff or BackoffPolicy(seed=seed)
+        self.trace = trace
+        self.cost_model = cost_model
+        self.stats = TransportStats()
+        self._senders: Dict[NodeId, _PeerSender] = {}
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._inbound_tasks: set = set()
+        self.addr: Optional[Addr] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def listen(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
+        self._server = await asyncio.start_server(
+            self._accept, host=host, port=port
+        )
+        sock = self._server.sockets[0]
+        self.addr = sock.getsockname()[:2]
+        return self.addr
+
+    def add_peer(self, peer_id: NodeId, addr: Addr) -> None:
+        if peer_id in self._senders:
+            raise ValueError(f"peer {peer_id!r} already added")
+        sender = _PeerSender(self, peer_id, addr)
+        self._senders[peer_id] = sender
+        sender.start()
+
+    async def stop(self) -> None:
+        for sender in self._senders.values():
+            await sender.stop()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for task in list(self._inbound_tasks):
+            task.cancel()
+        await asyncio.gather(*self._inbound_tasks, return_exceptions=True)
+
+    # -- sending -------------------------------------------------------------
+
+    def peer_ids(self) -> List[NodeId]:
+        return sorted(self._senders.keys(), key=repr)
+
+    def connected(self, peer_id: NodeId) -> bool:
+        sender = self._senders.get(peer_id)
+        return sender is not None and sender.connected.is_set()
+
+    def queued(self, peer_id: NodeId) -> int:
+        sender = self._senders.get(peer_id)
+        return 0 if sender is None else len(sender.outbox)
+
+    def send(self, peer_id: NodeId, payload: bytes) -> None:
+        """Queue one consensus MSG frame for ``peer_id``."""
+        self.send_frame(peer_id, framing.MSG, payload)
+
+    def send_frame(self, peer_id: NodeId, kind: int, payload: bytes) -> None:
+        sender = self._senders.get(peer_id)
+        if sender is None:
+            raise KeyError(f"unknown peer {peer_id!r}")
+        sender.send(framing.encode_frame(kind, payload, self.max_frame))
+
+    def local_hello(self) -> Hello:
+        era, epoch = self.hello_key()
+        return Hello(node_id=self.our_id, role=ROLE_NODE,
+                     cluster_id=self.cluster_id, era=era, epoch=epoch)
+
+    # -- receiving -----------------------------------------------------------
+
+    async def _accept(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        self._inbound_tasks.add(task)
+        try:
+            await self._serve_inbound(reader, writer)
+        except (
+            OSError, FrameError, ValueError,
+            asyncio.IncompleteReadError, asyncio.TimeoutError,
+        ) as exc:
+            logger.debug("inbound connection dropped: %r", exc)
+        finally:
+            self._inbound_tasks.discard(task)
+            writer.close()
+
+    async def _serve_inbound(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        kind, payload = await asyncio.wait_for(
+            framing.read_one_frame(reader, self.max_frame), self.dead_after_s
+        )
+        if kind != framing.HELLO:
+            raise FrameError(f"first frame must be HELLO, got kind {kind}")
+        hello = framing.decode_hello(payload)
+        if hello.cluster_id != self.cluster_id:
+            raise FrameError("cluster id mismatch")
+        if hello.role == ROLE_NODE and hello.node_id not in self._senders:
+            raise FrameError(
+                f"node hello from unknown peer {hello.node_id!r}"
+            )
+        reply = framing.encode_frame(
+            framing.HELLO, framing.encode_hello(self.local_hello()),
+            self.max_frame,
+        )
+        writer.write(reply)
+        await writer.drain()
+        self._record_send(hello.node_id, reply)
+        if hello.role == ROLE_NODE:
+            self._notify_hello(hello.node_id, hello, direction="accept")
+            await self._node_recv_loop(hello.node_id, reader, writer)
+        else:
+            await self._client_recv_loop(hello, reader, writer)
+
+    async def _node_recv_loop(self, peer_id: NodeId,
+                              reader: asyncio.StreamReader,
+                              writer: asyncio.StreamWriter) -> None:
+        decoder = FrameDecoder(self.max_frame)
+        # a live dialer pings every heartbeat_s, so silence beyond the
+        # dead-peer window means a half-open socket (peer power-loss,
+        # partition): time the read out or this task and its fd would
+        # leak forever — the dialer side re-dials with a fresh connection
+        idle_timeout = self.dead_after_s * 2 + 1.0
+        while True:
+            data = await asyncio.wait_for(reader.read(65536), idle_timeout)
+            if not data:
+                return
+            for kind, payload in decoder.feed(data):
+                self._record_recv(peer_id, kind, payload)
+                if kind == framing.PING:
+                    pong = framing.encode_frame(
+                        framing.PONG, payload, self.max_frame
+                    )
+                    writer.write(pong)
+                    await writer.drain()
+                    self._record_send(peer_id, pong)
+                elif kind == framing.MSG:
+                    if self.on_peer_message is not None:
+                        self.on_peer_message(peer_id, payload)
+                else:
+                    raise FrameError(
+                        f"unexpected frame kind {kind} from node "
+                        f"{peer_id!r}"
+                    )
+
+    async def _client_recv_loop(self, hello: Hello,
+                                reader: asyncio.StreamReader,
+                                writer: asyncio.StreamWriter) -> None:
+        conn = ClientConn(hello, writer, self.max_frame,
+                          record_send=self._record_send)
+        decoder = FrameDecoder(self.max_frame)
+        try:
+            while True:
+                # clients keep-alive every ~10 s (ClusterClient); longer
+                # silence is a half-open socket — reclaim the task/fd
+                data = await asyncio.wait_for(
+                    reader.read(65536), self.client_idle_timeout_s
+                )
+                if not data:
+                    return
+                for kind, payload in decoder.feed(data):
+                    self._record_recv(hello.node_id, kind, payload)
+                    if kind == framing.PING:
+                        conn.send(framing.PONG, payload)
+                    elif self.on_client_frame is not None:
+                        self.on_client_frame(conn, kind, payload)
+        finally:
+            conn.closed = True
+            if self.on_client_gone is not None:
+                self.on_client_gone(conn)
+
+    # -- accounting ----------------------------------------------------------
+
+    def _notify_hello(self, peer_id: NodeId, hello: Hello,
+                      direction: str) -> None:
+        if self.on_peer_hello is not None:
+            self.on_peer_hello(peer_id, hello, direction)
+
+    def _record_send(self, peer_id: NodeId, frame: bytes) -> None:
+        self.stats.frames_sent += 1
+        self.stats.bytes_sent += len(frame)
+        if self.trace is not None:
+            from hbbft_tpu.sim.trace import NetEvent
+
+            self.trace.record_net(NetEvent(
+                direction="send", peer=peer_id,
+                kind=framing.KIND_NAMES.get(frame[4], str(frame[4])),
+                wire_bytes=len(frame), t_mono=time.monotonic(),
+            ))
+
+    def _record_recv(self, peer_id: NodeId, kind: int,
+                     payload: bytes) -> None:
+        nbytes = len(payload) + 5
+        self.stats.frames_recv += 1
+        self.stats.bytes_recv += nbytes
+        if self.cost_model is not None:
+            self.stats.virtual_cost_s += self.cost_model.charge(nbytes)
+        if self.trace is not None:
+            from hbbft_tpu.sim.trace import NetEvent
+
+            self.trace.record_net(NetEvent(
+                direction="recv", peer=peer_id,
+                kind=framing.KIND_NAMES.get(kind, str(kind)),
+                wire_bytes=nbytes, t_mono=time.monotonic(),
+            ))

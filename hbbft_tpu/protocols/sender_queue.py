@@ -14,7 +14,7 @@ HoneyBadger uses era 0.  A message is deliverable to a peer once
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
+from typing import Any, Callable, Dict, Hashable, Iterable, List, Optional, Tuple
 
 from hbbft_tpu.protocols.dynamic_honey_badger import (
     DhbBatch,
@@ -45,7 +45,7 @@ class AlgoMessage:
     msg: Any
 
 
-def _message_key(msg: Any) -> EpochKey:
+def message_key(msg: Any) -> EpochKey:
     """The (era, epoch) a message belongs to.
 
     Every message type the wrapped algorithms emit is enumerated; an unknown
@@ -134,6 +134,59 @@ class SenderQueue(ConsensusProtocol):
         window = _algo_window(self.algo)
         return key <= (era, epoch + window)
 
+    def reinit_peer(
+        self,
+        peer: NodeId,
+        key: EpochKey,
+        history: Iterable[Tuple[EpochKey, Any]] = (),
+    ) -> Step:
+        """A peer restarted at ``key``, below its recorded epoch: rewind its
+        record and re-feed it the epoch-ordered backlog.
+
+        ``history`` is the caller's replay log of messages that were already
+        handed to the network for this peer (the net runtime retains the
+        recent (key, message) pairs it sent; ``_peer_advanced`` alone cannot
+        help a restarted peer because those messages left the buffer when
+        they were first deliverable).  The backlog — history merged with
+        anything still buffered here — is re-run through the buffering
+        discipline: messages within the peer's new window are re-sent now,
+        the rest are held back and flow in order as the peer announces
+        ``EpochStarted`` progress while it replays the protocol.
+
+        Duplicates at the peer are safe: the protocols treat a repeated
+        well-typed message as a no-op or a logged fault, never corruption.
+        The merged backlog is value-deduped so a flapping peer (one
+        reinit per reconnect, and reconnects come in pairs — dial and
+        accept hellos) cannot accumulate copies of the same held-back
+        entries across calls.
+        """
+        merged = sorted(
+            list(history) + self.buffered.pop(peer, []),
+            key=lambda kv: kv[0],
+        )
+        seen: set = set()
+        backlog: List[Tuple[EpochKey, Any]] = []
+        for entry in merged:
+            if entry in seen:
+                continue
+            seen.add(entry)
+            backlog.append(entry)
+        self.peer_epochs[peer] = key
+        step = Step()
+        keep: List[Tuple[EpochKey, Any]] = []
+        for mkey, msg in backlog:
+            if self._deliverable(mkey, peer):
+                step.send_to(peer, AlgoMessage(msg))
+            else:
+                keep.append((mkey, msg))
+        if keep:
+            self.buffered[peer] = keep
+        # re-announce ourselves so the restarted peer learns our epoch and
+        # can address us immediately
+        cur = _algo_key(self.algo)
+        step.send_to(peer, EpochStarted(cur))
+        return step
+
     def _peer_advanced(self, peer: NodeId, key: EpochKey) -> Step:
         cur = self.peer_epochs.get(peer)
         if cur is not None and key <= cur:
@@ -157,7 +210,7 @@ class SenderQueue(ConsensusProtocol):
         step = Step(output=inner.output, fault_log=inner.fault_log)
         peers = [n for n in self._known_peers() if n != self.our_id()]
         for tm in inner.messages:
-            key = _message_key(tm.message)
+            key = message_key(tm.message)
             for peer in peers:
                 if not tm.target.contains(peer):
                     continue

@@ -18,15 +18,37 @@ from hbbft_tpu.crypto import tc
 
 NodeId = Hashable
 
+# Hard decode-side size caps.  A length prefix is attacker-controlled bytes;
+# without a cap a single forged u32 makes the reader attempt a 4 GiB
+# allocation (or, with nesting, many of them).  8 MiB covers every honest
+# payload of the shipped configurations (contributions, shards, votes; a
+# full batch-size contribution set is bounded at mempool admission —
+# net/client.Mempool.max_tx_bytes).  Known exception: a DKG key-gen Part
+# carries a 97·(f+1)²-byte bivariate commitment, which crosses 8 MiB
+# around N ≈ 880 — a networked cluster rotating keys at that scale must
+# raise these two module constants (they are resolved at call time, so
+# assigning wire.MAX_BLOB_BYTES/MAX_MESSAGE_BYTES takes effect) and pass
+# a matching max_frame to its Transport/NodeRuntime.  The network layer
+# enforces its frame cap on top (net/framing.py).
+MAX_BLOB_BYTES = 8 * 2**20
+MAX_MESSAGE_BYTES = MAX_BLOB_BYTES + 4096
+
 
 class Reader:
-    def __init__(self, data: bytes):
+    def __init__(self, data: bytes, max_blob: Optional[int] = None):
         self.data = data
         self.pos = 0
+        # resolved at call time so deployments can raise the module knob
+        self.max_blob = MAX_BLOB_BYTES if max_blob is None else max_blob
 
     def take(self, n: int) -> bytes:
+        if n < 0:
+            raise ValueError(f"negative read of {n} bytes")
         if self.pos + n > len(self.data):
-            raise ValueError("truncated")
+            raise ValueError(
+                f"truncated: need {n} bytes at offset {self.pos}, "
+                f"have {len(self.data) - self.pos}"
+            )
         out = self.data[self.pos : self.pos + n]
         self.pos += n
         return out
@@ -38,7 +60,12 @@ class Reader:
         return struct.unpack(">Q", self.take(8))[0]
 
     def blob(self) -> bytes:
-        return self.take(self.u32())
+        n = self.u32()
+        if n > self.max_blob:
+            raise ValueError(
+                f"blob length {n} exceeds cap {self.max_blob}"
+            )
+        return self.take(n)
 
     def done(self) -> bool:
         return self.pos == len(self.data)
@@ -149,8 +176,14 @@ def encode_message(msg) -> bytes:
     return bytes([tag]) + enc(msg)
 
 
-def decode_message(data: bytes):
+def decode_message(data: bytes, max_bytes: Optional[int] = None):
     _lazy_register()
+    if max_bytes is None:
+        max_bytes = MAX_MESSAGE_BYTES
+    if len(data) > max_bytes:
+        raise ValueError(
+            f"message of {len(data)} bytes exceeds cap {max_bytes}"
+        )
     r = Reader(data)
     msg = _read_message(r)
     if not r.done():

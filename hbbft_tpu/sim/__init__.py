@@ -17,5 +17,5 @@ from hbbft_tpu.sim.adversary import (
     RandomAdversary,
     ReorderingAdversary,
 )
-from hbbft_tpu.sim.trace import CostModel, CrankEvent, EventLog
+from hbbft_tpu.sim.trace import CostModel, CrankEvent, EventLog, NetEvent
 from hbbft_tpu.sim.virtual_net import CrankError, NetBuilder, VirtualNet

@@ -37,10 +37,26 @@ class CrankEvent:
 
 
 @dataclass
+class NetEvent:
+    """One frame crossing the real transport (net/transport.py) — the
+    socket-layer sibling of :class:`CrankEvent`.  ``direction`` is ``"send"``
+    or ``"recv"`` from the recording node's perspective; ``kind`` is the
+    frame-kind name (MSG/PING/TX/…); ``wire_bytes`` counts the framed size
+    including the length prefix."""
+
+    direction: str
+    peer: Hashable
+    kind: str
+    wire_bytes: int
+    t_mono: float
+
+
+@dataclass
 class EventLog:
     """Append-only per-crank event records with summary accessors."""
 
     events: List[CrankEvent] = field(default_factory=list)
+    net_events: List[NetEvent] = field(default_factory=list)
 
     def record(self, ev: CrankEvent) -> None:
         self.events.append(ev)
@@ -48,6 +64,31 @@ class EventLog:
             "crank %d: %s→%s %s (%dB) outputs=%d faults=%d t=%.6f",
             ev.crank, ev.sender, ev.dest, ev.msg_type, ev.wire_bytes,
             ev.outputs, ev.faults, ev.virtual_time,
+        )
+
+    def record_net(self, ev: NetEvent) -> None:
+        self.net_events.append(ev)
+        logger.debug(
+            "net %s %s %s (%dB)", ev.direction, ev.peer, ev.kind,
+            ev.wire_bytes,
+        )
+
+    def net_frames_by_kind(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for ev in self.net_events:
+            out[ev.kind] = out.get(ev.kind, 0) + 1
+        return out
+
+    def net_bytes_by_kind(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for ev in self.net_events:
+            out[ev.kind] = out.get(ev.kind, 0) + ev.wire_bytes
+        return out
+
+    def net_total_bytes(self, direction: Optional[str] = None) -> int:
+        return sum(
+            ev.wire_bytes for ev in self.net_events
+            if direction is None or ev.direction == direction
         )
 
     def messages_by_type(self) -> Dict[str, int]:

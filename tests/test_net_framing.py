@@ -1,0 +1,106 @@
+"""net/framing.py: frame round-trips, size caps, cut streams, hello."""
+
+import struct
+
+import pytest
+
+from hbbft_tpu.net import framing
+from hbbft_tpu.net.framing import (
+    FrameDecoder,
+    FrameError,
+    Hello,
+    ROLE_CLIENT,
+    ROLE_NODE,
+)
+
+
+def test_frame_roundtrip_all_kinds():
+    dec = FrameDecoder()
+    payloads = {
+        framing.HELLO: b"h" * 40,
+        framing.MSG: b"\x70" + b"\x00" * 16,
+        framing.PING: struct.pack(">Q", 7),
+        framing.TX: b"some transaction",
+        framing.STATUS_REQ: b"",
+    }
+    stream = b"".join(
+        framing.encode_frame(k, p) for k, p in payloads.items()
+    )
+    frames = dec.feed(stream)
+    assert frames == list(payloads.items())
+    assert dec.pending() == 0
+
+
+def test_decoder_byte_by_byte():
+    """Feeding one byte at a time never yields a partial frame."""
+    frames_in = [
+        (framing.MSG, b"alpha"),
+        (framing.PING, b"\x00" * 8),
+        (framing.TX, b""),
+    ]
+    stream = b"".join(framing.encode_frame(k, p) for k, p in frames_in)
+    dec = FrameDecoder()
+    out = []
+    for i in range(len(stream)):
+        out.extend(dec.feed(stream[i : i + 1]))
+    assert out == frames_in
+    assert dec.pending() == 0
+
+
+def test_cut_stream_stays_pending():
+    """A mid-frame cut yields nothing — no partial frames, no exception."""
+    frame = framing.encode_frame(framing.MSG, b"payload-bytes")
+    for cut in range(len(frame)):
+        dec = FrameDecoder()
+        assert dec.feed(frame[:cut]) == []
+        assert dec.pending() == cut
+        # the remainder completes it
+        assert dec.feed(frame[cut:]) == [(framing.MSG, b"payload-bytes")]
+
+
+def test_oversize_claim_rejected_before_buffering():
+    dec = FrameDecoder(max_frame=1024)
+    hostile = struct.pack(">I", 2**31) + b"\x02"
+    with pytest.raises(FrameError, match="exceeds cap"):
+        dec.feed(hostile)
+
+
+def test_zero_length_frame_rejected():
+    with pytest.raises(FrameError, match="zero-length"):
+        FrameDecoder().feed(struct.pack(">I", 0))
+
+
+def test_encode_frame_cap():
+    with pytest.raises(FrameError, match="exceeds cap"):
+        framing.encode_frame(framing.MSG, b"x" * 100, max_frame=50)
+
+
+def test_hello_roundtrip():
+    for nid in (3, "node-a", "client-7"):
+        for role in (ROLE_NODE, ROLE_CLIENT):
+            h = Hello(node_id=nid, role=role, cluster_id=b"cl/1",
+                      era=2, epoch=17)
+            assert framing.decode_hello(framing.encode_hello(h)) == h
+            assert h.key == (2, 17)
+
+
+def test_hello_version_mismatch_is_loud():
+    h = Hello(node_id=0, role=ROLE_NODE, cluster_id=b"c", era=0, epoch=0)
+    enc = bytearray(framing.encode_hello(h))
+    enc[4:8] = struct.pack(">I", framing.PROTOCOL_VERSION + 1)
+    with pytest.raises(FrameError, match="version mismatch"):
+        framing.decode_hello(bytes(enc))
+
+
+def test_hello_bad_magic_and_cuts():
+    h = Hello(node_id="n", role=ROLE_NODE, cluster_id=b"cluster",
+              era=1, epoch=5)
+    enc = framing.encode_hello(h)
+    with pytest.raises(FrameError, match="magic"):
+        framing.decode_hello(b"XXXX" + enc[4:])
+    # every truncation is a FrameError, never a crash or a partial Hello
+    for cut in range(len(enc)):
+        with pytest.raises(FrameError):
+            framing.decode_hello(enc[:cut])
+    with pytest.raises(FrameError, match="trailing"):
+        framing.decode_hello(enc + b"\x00")

@@ -1,0 +1,278 @@
+"""net/transport.py: deterministic backoff, reconnect, queue persistence."""
+
+import asyncio
+import socket
+import struct
+
+import pytest
+
+from hbbft_tpu.net import framing
+from hbbft_tpu.net.transport import BackoffPolicy, Transport
+
+
+def test_backoff_policy_deterministic():
+    """Same seed ⇒ identical delay schedule; the transport's reconnect
+    trace is a pure function of (seed, our_id, peer_id)."""
+    s1 = BackoffPolicy(seed=42).preview("0->1", 12)
+    s2 = BackoffPolicy(seed=42).preview("0->1", 12)
+    assert s1 == s2
+    assert BackoffPolicy(seed=43).preview("0->1", 12) != s1
+    assert BackoffPolicy(seed=42).preview("0->2", 12) != s1
+    # exponential growth with jitter in [raw·(1−j), raw), capped
+    for i, d in enumerate(s1):
+        raw = min(2.0, 0.05 * 2.0 ** i)
+        assert raw * 0.5 <= d < raw
+
+
+def test_backoff_stream_continues_across_outages():
+    """One rng stream per peer: successive outages continue the sequence
+    (attempt growth resets, the draws do not repeat)."""
+    policy = BackoffPolicy(seed=7)
+    rng = policy.rng_for("a->b")
+    seq = [policy.delay(i, rng) for i in range(3)]
+    seq += [policy.delay(i, rng) for i in range(3)]  # second outage
+    expect_rng = policy.rng_for("a->b")
+    expect = [
+        policy.delay(a, expect_rng) for a in (0, 1, 2, 0, 1, 2)
+    ]
+    assert seq == expect
+    assert len(set(seq)) == 6  # jitter keeps drawing fresh values
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_transport_reconnect_preserves_queue_and_schedule():
+    """Frames queued while the peer is down arrive in order after it comes
+    up, and the recorded backoff delays match the seeded schedule."""
+
+    async def scenario():
+        fast = BackoffPolicy(seed=5, base=0.01, cap=0.05)
+        got = []
+        ta = Transport(0, b"cl", backoff=fast)
+        await ta.listen()
+        port = _free_port()
+        ta.add_peer(1, ("127.0.0.1", port))
+        payloads = [b"first", b"second", b"third"]
+        for p in payloads:
+            ta.send(1, p)
+        await asyncio.sleep(0.25)  # several failed dials
+        delays = list(ta.stats.backoff_delays[1])
+        assert len(delays) >= 3
+        assert delays == fast.preview("0->1", len(delays))
+        assert ta.queued(1) == len(payloads)  # nothing lost while down
+
+        tb = Transport(1, b"cl",
+                       on_peer_message=lambda pid, data: got.append(
+                           (pid, data)))
+        await tb.listen("127.0.0.1", port)
+        tb.add_peer(0, ta.addr)
+        for _ in range(400):
+            if len(got) == len(payloads):
+                break
+            await asyncio.sleep(0.01)
+        assert got == [(0, p) for p in payloads]
+        assert ta.stats.frames_sent >= len(payloads)
+        assert tb.stats.frames_recv >= len(payloads)
+        await ta.stop()
+        await tb.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 20))
+
+
+def test_inbound_rejects_garbage_and_wrong_cluster():
+    """A garbage hello or a wrong cluster id closes the connection before
+    any payload frame is parsed; the transport keeps serving."""
+
+    async def scenario():
+        got = []
+        t = Transport(0, b"right-cluster",
+                      on_peer_message=lambda pid, data: got.append(data))
+        await t.listen()
+
+        async def probe(raw: bytes) -> bytes:
+            reader, writer = await asyncio.open_connection(*t.addr)
+            writer.write(raw)
+            await writer.drain()
+            data = await asyncio.wait_for(reader.read(4096), 5)
+            writer.close()
+            return data
+
+        # not a HELLO first
+        assert await probe(framing.encode_frame(framing.MSG, b"x")) == b""
+        # oversize claimed frame length
+        assert await probe(struct.pack(">I", 2 ** 31) + b"\x01") == b""
+        # wrong cluster id
+        bad = framing.encode_hello(framing.Hello(
+            node_id=1, role=framing.ROLE_NODE,
+            cluster_id=b"wrong-cluster", era=0, epoch=0))
+        assert await probe(framing.encode_frame(framing.HELLO, bad)) == b""
+        # node hello from an unknown peer id (no senders configured)
+        unknown = framing.encode_hello(framing.Hello(
+            node_id=9, role=framing.ROLE_NODE,
+            cluster_id=b"right-cluster", era=0, epoch=0))
+        assert await probe(
+            framing.encode_frame(framing.HELLO, unknown)) == b""
+        assert got == []
+        await t.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 20))
+
+
+def test_transport_counters_feed_eventlog_and_costmodel():
+    """Satellite wiring: real frames land in EventLog.net_events and
+    accrue virtual cost under the simulator's CostModel, so sim and net
+    runs report comparable numbers."""
+    from hbbft_tpu.sim.trace import CostModel, EventLog
+
+    async def scenario():
+        recv_log, send_log = EventLog(), EventLog()
+        cost = CostModel(bandwidth_bps=1e9, cpu_lag_s=1e-5)
+        got = []
+        tb = Transport(1, b"cl", trace=recv_log, cost_model=cost,
+                       on_peer_message=lambda pid, d: got.append(d))
+        await tb.listen()
+        ta = Transport(0, b"cl", trace=send_log)
+        await ta.listen()
+        tb.add_peer(0, ta.addr)
+        ta.add_peer(1, tb.addr)
+        for i in range(5):
+            ta.send(1, b"payload-%d" % i)
+        for _ in range(400):
+            if len(got) == 5:
+                break
+            await asyncio.sleep(0.01)
+        assert len(got) == 5
+        sent = [e for e in send_log.net_events
+                if e.direction == "send" and e.kind == "MSG"]
+        recvd = [e for e in recv_log.net_events
+                 if e.direction == "recv" and e.kind == "MSG"]
+        assert len(sent) == 5 and len(recvd) == 5
+        assert send_log.net_bytes_by_kind()["MSG"] == sum(
+            e.wire_bytes for e in sent
+        )
+        assert recv_log.net_frames_by_kind()["MSG"] == 5
+        assert recv_log.net_total_bytes("recv") > 0
+        # every received frame was charged on the synthetic clock (send
+        # events — hello replies, PONGs — are recorded but not charged)
+        expect = sum(cost.charge(e.wire_bytes)
+                     for e in recv_log.net_events
+                     if e.direction == "recv")
+        assert abs(tb.stats.virtual_cost_s - expect) < 1e-9
+        assert tb.stats.virtual_cost_s > 0
+        await ta.stop()
+        await tb.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 20))
+
+
+def test_mempool_bounds_tx_size_and_count():
+    from hbbft_tpu.net.client import Mempool
+
+    mp = Mempool(capacity=2, max_tx_bytes=16)
+    assert mp.add(b"x" * 17) == Mempool.REJECTED  # never retried
+    assert mp.add(b"a") == Mempool.ACCEPTED
+    assert mp.add(b"a") == Mempool.DUPLICATE
+    assert mp.add(b"b") == Mempool.ACCEPTED
+    assert mp.add(b"c") == Mempool.FULL  # backpressure: retry later
+    mp.mark_committed([b"a"])
+    assert mp.add(b"c") == Mempool.ACCEPTED
+    assert mp.add(b"a") == Mempool.DUPLICATE  # recently committed
+
+
+def test_replay_prune_survives_era_boundary():
+    """Regression: the replay floor must not discard the whole previous
+    era the moment a DKG rotation lands — a peer whose outage spans the
+    era boundary still needs the old-era tail replayed."""
+    import random
+
+    from hbbft_tpu.net.cluster import (
+        ClusterConfig, build_runtime, generate_infos,
+    )
+
+    cfg = ClusterConfig(n=4, seed=55)
+    rt = build_runtime(cfg, generate_infos(cfg), 0)
+    retain = rt.replay_retain_epochs
+    entries = [((0, 58), "a"), ((0, 63), "b"), ((1, 0), "c")]
+    # young era 1: previous era's tail is retained
+    rt._replay = {1: list(entries)}
+    rt.current_key = lambda: (1, 2)
+    rt._prune_replay()
+    assert rt._replay[1] == entries
+    # once era 1 is `retain` epochs old, the old era (and this era's own
+    # stale prefix) goes
+    rt._replay = {1: list(entries)}
+    rt.current_key = lambda: (1, retain + 6)
+    rt._prune_replay()
+    assert rt._replay[1] == []
+    # same-era pruning unchanged
+    rt._replay = {1: [((0, 1), "old"), ((0, retain + 3), "new")]}
+    rt.current_key = lambda: (0, retain + 5)
+    rt._prune_replay()
+    assert rt._replay[1] == [((0, retain + 3), "new")]
+
+
+def test_client_fails_fast_on_corrupt_stream():
+    """A hostile/corrupt frame from the node must fail every pending
+    client future immediately, not leak N× full timeouts."""
+    from hbbft_tpu.net.client import ClusterClient
+
+    async def scenario():
+        async def serve(reader, writer):
+            await reader.read(4096)  # client hello
+            reply = framing.encode_hello(framing.Hello(
+                node_id=0, role=framing.ROLE_NODE,
+                cluster_id=b"cl", era=0, epoch=0))
+            writer.write(framing.encode_frame(framing.HELLO, reply))
+            # then a frame claiming 2 GiB — the client decoder must bail
+            writer.write(struct.pack(">I", 2 ** 31) + b"\x07")
+            await writer.drain()
+
+        server = await asyncio.start_server(serve, "127.0.0.1", 0)
+        addr = server.sockets[0].getsockname()[:2]
+        client = ClusterClient(addr, b"cl")
+        await client.connect()
+        waiter = asyncio.ensure_future(
+            client.wait_committed(b"never", timeout_s=30)
+        )
+        with pytest.raises(ConnectionError):
+            await asyncio.wait_for(waiter, 5)
+        await client.close()
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(asyncio.wait_for(scenario(), 20))
+
+
+def test_hello_carries_current_epoch_key():
+    """Both hello directions surface the peers' (era, epoch) keys."""
+
+    async def scenario():
+        hellos = []
+        ta = Transport(0, b"cl", hello_key=lambda: (1, 7),
+                       on_peer_hello=lambda pid, h, d: hellos.append(
+                           ("a", pid, h.key, d)))
+        tb = Transport(1, b"cl", hello_key=lambda: (2, 9),
+                       on_peer_hello=lambda pid, h, d: hellos.append(
+                           ("b", pid, h.key, d)))
+        await ta.listen()
+        await tb.listen()
+        ta.add_peer(1, tb.addr)
+        tb.add_peer(0, ta.addr)
+        for _ in range(400):
+            if len(hellos) >= 4:
+                break
+            await asyncio.sleep(0.01)
+        assert ("a", 1, (2, 9), "dial") in hellos
+        assert ("b", 0, (1, 7), "accept") in hellos
+        assert ("b", 0, (1, 7), "dial") in hellos
+        assert ("a", 1, (2, 9), "accept") in hellos
+        await ta.stop()
+        await tb.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 20))

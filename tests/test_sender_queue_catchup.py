@@ -1,0 +1,189 @@
+"""SenderQueue catch-up: the restart path the net runtime relies on.
+
+Regression scenario (satellite of the net-subsystem PR): a peer restarts
+from scratch at (era, epoch) = (0, 0) after the others have reached epoch
+k.  The others' SenderQueues hold back its far-future messages; the
+runtime's replay log re-feeds the already-sent history through
+``reinit_peer``; the restarted peer must then receive the backlog *in
+epoch order*, released chunk by chunk as it announces ``EpochStarted``
+progress, and end up with the identical batch sequence.
+"""
+
+import random
+from typing import Any, Dict, List, Tuple
+
+from hbbft_tpu.netinfo import NetworkInfo
+from hbbft_tpu.protocols.dynamic_honey_badger import DynamicHoneyBadger
+from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
+from hbbft_tpu.protocols.queueing_honey_badger import (
+    QhbBatch,
+    QueueingHoneyBadger,
+    TxInput,
+)
+from hbbft_tpu.protocols.sender_queue import (
+    AlgoMessage,
+    EpochStarted,
+    SenderQueue,
+    _algo_key,
+    message_key,
+)
+
+N = 4
+DOWN = 3  # the node that is down, then restarts at (0, 0)
+
+
+def make_node(infos, nid) -> SenderQueue:
+    dhb = DynamicHoneyBadger(
+        infos[nid], infos[nid].secret_key(),
+        rng=random.Random(7000 + nid),
+        encryption_schedule=EncryptionSchedule.never(),
+    )
+    return SenderQueue(QueueingHoneyBadger(
+        dhb, batch_size=4, rng=random.Random(8000 + nid)
+    ))
+
+
+class Pump:
+    """Deterministic FIFO message pump with a runtime-style replay log.
+
+    Messages to a down node are recorded in ``history[sender]`` exactly as
+    the net runtime's per-peer replay log records frames it handed to the
+    transport (they were "sent", then lost with the dead process)."""
+
+    def __init__(self, nodes: Dict[int, SenderQueue]):
+        self.nodes = nodes
+        self.queue: List[Tuple[int, int, Any]] = []
+        self.down: set = set()
+        self.history: Dict[int, List[Tuple[Tuple[int, int], Any]]] = {}
+        # per-sender keys of AlgoMessages delivered to DOWN, in order
+        self.delivered_keys: Dict[int, List[Tuple[int, int]]] = {}
+
+    def fan_out(self, sender: int, step) -> None:
+        all_ids = sorted(self.nodes.keys())
+        for tm in step.messages:
+            for dest in tm.target.resolve(all_ids, sender):
+                self.queue.append((sender, dest, tm.message))
+
+    def run(self) -> None:
+        while self.queue:
+            sender, dest, msg = self.queue.pop(0)
+            if dest in self.down:
+                if isinstance(msg, AlgoMessage):
+                    self.history.setdefault(sender, []).append(
+                        (message_key(msg.msg), msg.msg)
+                    )
+                continue
+            if dest == DOWN and isinstance(msg, AlgoMessage):
+                self.delivered_keys.setdefault(sender, []).append(
+                    message_key(msg.msg)
+                )
+            step = self.nodes[dest].handle_message(sender, msg)
+            self.fan_out(dest, step)
+
+
+def test_restarted_peer_catches_up_in_order():
+    infos = NetworkInfo.generate_map(list(range(N)), random.Random(11))
+    nodes = {nid: make_node(infos, nid) for nid in range(N)}
+    outputs: Dict[int, List[QhbBatch]] = {nid: [] for nid in range(N)}
+
+    pump = Pump(nodes)
+
+    def wrap(nid):
+        node = nodes[nid]
+        inner = node.handle_message
+
+        def handler(sender, msg):
+            step = inner(sender, msg)
+            outputs[nid].extend(
+                o for o in step.output if isinstance(o, QhbBatch)
+            )
+            return step
+
+        node.handle_message = handler
+
+    for nid in range(N):
+        wrap(nid)
+
+    # phase 1: node DOWN is dead from the start; the others run k epochs
+    pump.down = {DOWN}
+    for e in range(7):
+        for nid in range(N - 1):
+            step = nodes[nid].handle_input(
+                TxInput(b"tx-%d-%d" % (e, nid))
+            )
+            outputs[nid].extend(
+                o for o in step.output if isinstance(o, QhbBatch)
+            )
+            pump.fan_out(nid, step)
+        pump.run()
+
+    k = _algo_key(nodes[0].algo)[1]
+    assert k >= 5, f"live nodes only reached epoch {k}"
+    window = nodes[0].algo.dhb.max_future_epochs
+    # the exact premise of the catch-up path: with DOWN never announcing,
+    # everything beyond (0, window) was held back, the rest was "sent"
+    # (recorded in the replay history)
+    for nid in range(N - 1):
+        held = nodes[nid].buffered.get(DOWN, [])
+        assert held, f"node {nid} held nothing back for the dead peer"
+        assert all(key > (0, window) for key, _m in held)
+        assert any(key <= (0, window) for key, _m in pump.history[nid])
+
+    # phase 2: DOWN restarts from scratch at (0, 0)
+    nodes[DOWN] = make_node(infos, DOWN)
+    wrap(DOWN)
+    pump.down = set()
+    for nid in range(N - 1):
+        step = nodes[nid].reinit_peer(
+            DOWN, (0, 0), pump.history.get(nid, [])
+        )
+        pump.fan_out(nid, step)
+    pump.run()
+
+    # the restarted peer replayed to the same epoch with identical batches
+    assert _algo_key(nodes[DOWN].algo) == _algo_key(nodes[0].algo)
+    ref = [(b.era, b.epoch, tuple(b.all_txs())) for b in outputs[0]]
+    got = [(b.era, b.epoch, tuple(b.all_txs())) for b in outputs[DOWN]]
+    assert got == ref and len(ref) >= 5
+
+    # and the backlog arrived in epoch order, per sender: held-back
+    # messages were only released as EpochStarted announcements advanced
+    for nid in range(N - 1):
+        keys = pump.delivered_keys.get(nid, [])
+        assert keys, f"no replayed traffic from node {nid}"
+        assert keys == sorted(keys), (
+            f"out-of-order catch-up from node {nid}: {keys}"
+        )
+
+
+def test_reinit_peer_rewinds_and_rebuffers():
+    """Unit shape: reinit_peer re-sends only the deliverable prefix of the
+    merged history+buffer backlog, holds the rest, re-announces our key."""
+    infos = NetworkInfo.generate_map(list(range(N)), random.Random(13))
+    node = make_node(infos, 0)
+    window = node.algo.dhb.max_future_epochs
+    # pretend peer 1 was known at epoch 9 with two messages buffered
+    node.peer_epochs[1] = (0, 9)
+    from hbbft_tpu.protocols.dynamic_honey_badger import HbWrap
+    from hbbft_tpu.protocols.honey_badger import SubsetWrap
+
+    def fake(epoch):
+        return HbWrap(0, SubsetWrap(epoch, None))
+
+    node.buffered[1] = [((0, 14), fake(14)), ((0, 15), fake(15))]
+    history = [((0, e), fake(e)) for e in range(6)]
+
+    step = node.reinit_peer(1, (0, 0), history)
+    assert node.peer_epochs[1] == (0, 0)
+    sent = [tm.message for tm in step.messages]
+    algo_sent = [m for m in sent if isinstance(m, AlgoMessage)]
+    # deliverable prefix: epochs 0..window
+    assert [message_key(m.msg) for m in algo_sent] == [
+        (0, e) for e in range(window + 1)
+    ]
+    # the rest (history tail + old buffer) is held back, in key order
+    assert [key for key, _m in node.buffered[1]] == (
+        [(0, e) for e in range(window + 1, 6)] + [(0, 14), (0, 15)]
+    )
+    # and we re-announced our own epoch to the restarted peer
+    assert any(isinstance(m, EpochStarted) for m in sent)

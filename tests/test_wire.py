@@ -189,6 +189,62 @@ def test_live_dhb_traffic_roundtrips():
     assert "HbWrap" in kinds
 
 
+def _sample_messages(crypto_bits):
+    share, dshare, sig = crypto_bits
+    tree = MerkleTree([b"shard-%d" % i for i in range(7)])
+    skg = SignedKeyGenMsg(1, 3, "ack", b"\x00\x01\x02", sig)
+    return [
+        ValueMsg(tree.proof(3)),
+        EchoMsg(tree.proof(0)),
+        ReadyMsg(tree.root_hash()),
+        BValMsg(5, True),
+        ConfMsg(3, BOTH),
+        CoinMsg(5, ThresholdSignMessage(share)),
+        DecryptionShareWrap(4, 2, DecryptionMessage(dshare)),
+        KeyGenWrap(1, skg),
+        HbWrap(2, SubsetWrap(0, AgreementWrap(1, TermMsg(True)))),
+        AlgoMessage(HbWrap(0, SubsetWrap(0, BroadcastWrap(
+            0, EchoMsg(tree.proof(1)))))),
+        EpochStarted((3, 11)),
+    ]
+
+
+def test_mid_frame_cut_fuzz(crypto_bits):
+    """Every mid-frame cut of every message type dies with ValueError —
+    loudly, never a wrong decode, never a non-ValueError crash."""
+    for msg in _sample_messages(crypto_bits):
+        enc = wire.encode_message(msg)
+        for cut in range(len(enc)):
+            with pytest.raises(ValueError):
+                wire.decode_message(enc[:cut])
+
+
+def test_blob_cap_rejected_before_allocation():
+    """A forged length prefix beyond the blob cap raises even though the
+    buffer is short — the cap check precedes the truncation check."""
+    r = wire.Reader(b"\xff\xff\xff\xff tiny", max_blob=1024)
+    with pytest.raises(ValueError, match="exceeds cap"):
+        r.blob()
+    # a ciphertext message whose inner blob claims 2 GiB
+    forged = b"\x31" + b"\x80\x00\x00\x00"
+    with pytest.raises(ValueError, match="exceeds cap"):
+        wire.decode_message(forged)
+
+
+def test_message_byte_cap():
+    big = wire.encode_message(ReadyMsg(b"\x01" * 32))
+    with pytest.raises(ValueError, match="exceeds cap"):
+        wire.decode_message(big, max_bytes=len(big) - 1)
+    assert wire.decode_message(big, max_bytes=len(big)) == ReadyMsg(
+        b"\x01" * 32
+    )
+
+
+def test_truncation_error_is_descriptive():
+    with pytest.raises(ValueError, match="truncated: need"):
+        wire.Reader(b"\x00\x00").u32()
+
+
 def test_echo_hash_can_decode_roundtrip():
     from hbbft_tpu.protocols.broadcast import CanDecodeMsg, EchoHashMsg
 
