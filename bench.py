@@ -1042,6 +1042,76 @@ def sustained4096(epochs: int, n: int = 4096, tx_bytes: int = 64):
         emit()
 
 
+def _net_phase_summary(span_dicts):
+    """The per-phase latency breakdown from the nodes' /spans exports.
+
+    Two complementary views per committed epoch:
+
+    - raw per-phase span durations (first→last activity of that phase),
+      summarized as p50/p99 per coarse group (rbc / aba / coin / decrypt);
+    - a *partition attribution*: the epoch timeline is split at each
+      phase's start, so each group's attributed time answers "where did
+      this epoch's latency go" and the groups sum to the epoch wall
+      (first activity → commit) by construction — ``attr_sum_over_wall``
+      is the sanity ratio (1.0 up to float noise); ``raw_sum_over_wall``
+      is the overlap-sensitive raw ratio, reported for honesty.
+    """
+    from hbbft_tpu.net.client import percentile
+    from hbbft_tpu.obs.spans import phase_group
+
+    by_epoch = {}
+    for s in span_dicts:
+        by_epoch.setdefault((s["node"], s["era"], s["epoch"]),
+                            []).append(s)
+
+    def pct(vals, p):
+        return percentile(sorted(vals), p) if vals else None
+
+    group_durs, attr = {}, {}
+    walls, attr_ratios, raw_ratios = [], [], []
+    for _key, spans in by_epoch.items():
+        epoch = [s for s in spans if s["name"] == "epoch"]
+        phases = [s for s in spans
+                  if s["name"] not in ("epoch", "dkg_rotation")]
+        if not epoch or not phases:
+            continue
+        wall, t_end = epoch[0]["duration_s"], epoch[0]["t_end"]
+        walls.append(wall)
+        for s in phases:
+            group_durs.setdefault(phase_group(s["name"]),
+                                  []).append(s["duration_s"])
+        ordered = sorted(phases, key=lambda s: s["t_start"])
+        per = {}
+        for i, s in enumerate(ordered):
+            t1 = (ordered[i + 1]["t_start"] if i + 1 < len(ordered)
+                  else t_end)
+            g = phase_group(s["name"])
+            per[g] = per.get(g, 0.0) + max(t1 - s["t_start"], 0.0)
+        for g, v in per.items():
+            attr.setdefault(g, []).append(v)
+        if wall > 0:
+            attr_ratios.append(sum(per.values()) / wall)
+            raw_ratios.append(
+                sum(s["duration_s"] for s in phases) / wall)
+
+    out = {"epochs_observed": len(walls)}
+    for g in ("rbc", "aba", "coin", "decrypt"):
+        durs = group_durs.get(g)
+        out[g] = {
+            "p50_ms": round(pct(durs, 0.50) * 1e3, 3) if durs else None,
+            "p99_ms": round(pct(durs, 0.99) * 1e3, 3) if durs else None,
+            "spans": len(durs or ()),
+            "attr_p50_ms": (round(pct(attr[g], 0.50) * 1e3, 3)
+                            if g in attr else None),
+        }
+    if walls:
+        out["epoch_wall_p50_ms"] = round(pct(walls, 0.50) * 1e3, 3)
+        out["epoch_wall_p99_ms"] = round(pct(walls, 0.99) * 1e3, 3)
+        out["attr_sum_over_wall_p50"] = round(pct(attr_ratios, 0.50), 3)
+        out["raw_sum_over_wall_p50"] = round(pct(raw_ratios, 0.50), 3)
+    return out
+
+
 def net_cluster_bench(epochs_target: int = 20, n: int = 4,
                       batch_size: int = 8, tx_size: int = 64):
     """Localhost 4-node networked QHB benchmark (`--net`).
@@ -1054,8 +1124,10 @@ def net_cluster_bench(epochs_target: int = 20, n: int = 4,
     Price of Threshold Cryptosystems" says to measure.  The baseline for
     ``vs_baseline`` is the SAME workload on the in-process ``VirtualNet``
     simulator (tx/s over wall clock): the ratio is the real-socket tax the
-    net stack pays over the crank loop.  One JSON line either way, same
-    contract as the config pass.
+    net stack pays over the crank loop.  Each node also serves its obs
+    endpoint; the JSON line gains a ``phases`` object (per-phase p50/p99 +
+    epoch-latency attribution) built from every node's ``/spans`` export.
+    One JSON line either way, same contract as the config pass.
     """
     import asyncio
     import random
@@ -1066,9 +1138,11 @@ def net_cluster_bench(epochs_target: int = 20, n: int = 4,
         ClusterConfig, assert_status_chains_consistent, connect_when_up,
         find_free_base_port, shutdown_procs, spawn_node,
     )
+    from hbbft_tpu.obs.http import http_get
 
+    base = find_free_base_port(2 * n)
     cfg = ClusterConfig(n=n, seed=9, batch_size=batch_size,
-                        base_port=find_free_base_port(n))
+                        base_port=base, metrics_base_port=base + n)
     procs = {nid: spawn_node(cfg, nid, stdout=subprocess.DEVNULL,
                              stderr=subprocess.STDOUT)
              for nid in range(n)}
@@ -1126,6 +1200,20 @@ def net_cluster_bench(epochs_target: int = 20, n: int = 4,
 
     try:
         net = asyncio.run(session())
+        # every node's epoch-phase spans, while the processes are still up
+        span_dicts = []
+        for nid in range(n):
+            host, mport = cfg.metrics_addr(nid)
+            try:
+                body = http_get(host, mport, "/spans", timeout_s=5.0)
+            except (OSError, ValueError) as exc:
+                print(f"# spans fetch from node {nid} failed: {exc!r}",
+                      file=sys.stderr)
+                continue
+            span_dicts.extend(
+                json.loads(line) for line in body.splitlines() if line
+            )
+        phases = _net_phase_summary(span_dicts)
     finally:
         shutdown_procs(procs.values())
 
@@ -1183,6 +1271,7 @@ def net_cluster_bench(epochs_target: int = 20, n: int = 4,
         "p99_latency_ms": net["p99_ms"],
         "sim_baseline_tx_per_s": round(sim_tx_rate, 1),
         "sim_baseline_epochs": sim_epochs,
+        "phases": phases,
         "transport": net["transport"],
     }
     print(json.dumps(line), flush=True)

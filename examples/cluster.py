@@ -78,18 +78,36 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--base-port", type=int, default=0,
                     help="0 → pick a free range automatically")
+    ap.add_argument("--metrics-base-port", type=int, default=0,
+                    help="obs endpoints (/metrics /status /spans) at "
+                         "base+i; 0 → auto (node ports + n); -1 → off")
     ap.add_argument("--encrypt", action="store_true",
                     help="TPKE-encrypt contributions (EncryptionSchedule "
                          "always instead of never)")
     args = ap.parse_args()
 
+    if args.base_port:
+        base = args.base_port
+        metrics_base = args.metrics_base_port or base + args.nodes
+    else:
+        # one contiguous free range covers both: node ports in the first
+        # half, obs endpoints in the second
+        base = find_free_base_port(2 * args.nodes)
+        metrics_base = args.metrics_base_port or base + args.nodes
+    if args.metrics_base_port == -1:
+        metrics_base = 0
     cfg = ClusterConfig(
-        n=args.nodes, seed=args.seed,
-        base_port=args.base_port or find_free_base_port(args.nodes),
+        n=args.nodes, seed=args.seed, base_port=base,
+        metrics_base_port=metrics_base,
         batch_size=args.batch_size, encrypt=args.encrypt,
     )
     print(f"spawning {cfg.n} node processes on "
           f"{cfg.host}:{cfg.base_port}..{cfg.base_port + cfg.n - 1}…")
+    if metrics_base:
+        print(f"obs endpoints: http://{cfg.host}:{metrics_base}.."
+              f"{metrics_base + cfg.n - 1}/metrics — watch live with\n"
+              f"    python -m hbbft_tpu.obs.top "
+              f"--base-port {metrics_base} --nodes {cfg.n}")
     procs = {nid: spawn_node(cfg, nid) for nid in range(cfg.n)}
 
     async def session():

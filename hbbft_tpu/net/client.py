@@ -36,17 +36,21 @@ def tx_digest(tx: bytes) -> bytes:
     return hashlib.sha3_256(tx).digest()
 
 
+def percentile(vals: List[float], p: float) -> float:
+    """Nearest-rank percentile of a pre-sorted sequence — the one
+    definition every latency summary in the repo shares (client
+    submit→commit, bench phase breakdowns)."""
+    return vals[min(len(vals) - 1, int(p * (len(vals) - 1) + 0.5))]
+
+
 def latency_percentiles(latencies) -> Dict[str, float]:
     """p50/p90/p99/max summary of a sequence of latency seconds."""
     vals = sorted(latencies)
     if not vals:
         return {}
-
-    def pct(p: float) -> float:
-        return vals[min(len(vals) - 1, int(p * (len(vals) - 1) + 0.5))]
-
     return {
-        "p50_s": pct(0.50), "p90_s": pct(0.90), "p99_s": pct(0.99),
+        "p50_s": percentile(vals, 0.50), "p90_s": percentile(vals, 0.90),
+        "p99_s": percentile(vals, 0.99),
         "max_s": vals[-1], "count": len(vals),
     }
 
@@ -66,9 +70,17 @@ class Mempool:
     FULL = framing.ACK_FULL
     REJECTED = framing.ACK_REJECTED
 
+    _ACK_NAMES = {
+        framing.ACK_ACCEPTED: "accepted",
+        framing.ACK_DUPLICATE: "duplicate",
+        framing.ACK_FULL: "full",
+        framing.ACK_REJECTED: "rejected",
+    }
+
     def __init__(self, capacity: int = 10_000, seen_cap: int = 100_000,
                  max_tx_bytes: int = 256 * 1024,
-                 max_pending_bytes: int = 64 * 2**20):
+                 max_pending_bytes: int = 64 * 2**20,
+                 registry=None):
         self.capacity = capacity
         self.seen_cap = seen_cap
         self.max_tx_bytes = max_tx_bytes
@@ -78,19 +90,48 @@ class Mempool:
         self.pending_bytes = 0
         self._pending: "OrderedDict[bytes, bytes]" = OrderedDict()  # digest→tx
         self._seen: "OrderedDict[bytes, None]" = OrderedDict()  # recent commits
+        self._acks = None
+        if registry is not None:
+            self.bind_registry(registry)
+
+    def bind_registry(self, registry) -> None:
+        """Attach admission metrics to a node's registry (the runtime does
+        this, so a caller-supplied mempool is counted too); gauges for
+        depth and byte budget are registered via the collect callback."""
+        self._acks = registry.counter(
+            "hbbft_node_mempool_acks_total",
+            "client/local tx admissions by outcome",
+            labelnames=("status",), max_label_sets=len(self._ACK_NAMES) + 1,
+        )
+        for name in self._ACK_NAMES.values():
+            self._acks.labels(status=name)
+        g_pending = registry.gauge(
+            "hbbft_node_mempool_pending", "not-yet-committed transactions")
+        g_bytes = registry.gauge(
+            "hbbft_node_mempool_pending_bytes",
+            "bytes held by pending transactions")
+        registry.register_callback(lambda: (
+            g_pending.set(len(self._pending)),
+            g_bytes.set(self.pending_bytes),
+        ))
+
+    def _count(self, status: int) -> int:
+        if self._acks is not None:
+            self._acks.labels(status=self._ACK_NAMES[status]).inc()
+        return status
 
     def add(self, tx: bytes) -> int:
         if len(tx) > self.max_tx_bytes:
-            return self.REJECTED
+            return self._count(self.REJECTED)
         digest = tx_digest(tx)
         if digest in self._pending or digest in self._seen:
-            return self.DUPLICATE
+            return self._count(self.DUPLICATE)
         if (len(self._pending) >= self.capacity
                 or self.pending_bytes + len(tx) > self.max_pending_bytes):
-            return self.FULL
+            return self._count(self.FULL)
         self._pending[digest] = tx
         self.pending_bytes += len(tx)
-        return self.ACCEPTED
+        return self._count(self.ACCEPTED)
 
     def mark_committed(self, txs) -> List[bytes]:
         """Drop committed txs from pending; returns their digests."""

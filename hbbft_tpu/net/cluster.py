@@ -54,6 +54,10 @@ class ClusterConfig:
     heartbeat_s: float = 0.5
     dead_after_s: float = 3.0
     replay_retain_epochs: int = 64
+    # obs endpoint (/metrics /status /spans) base port: node i serves on
+    # metrics_base_port + i; 0 → no fixed obs ports (LocalCluster still
+    # opens ephemeral ones)
+    metrics_base_port: int = 0
 
     @property
     def cluster_id(self) -> bytes:
@@ -67,6 +71,11 @@ class ClusterConfig:
 
     def addr_map(self) -> Dict[int, Addr]:
         return {nid: self.addr(nid) for nid in range(self.n)}
+
+    def metrics_addr(self, nid: int) -> Addr:
+        if self.metrics_base_port == 0:
+            raise ValueError("metrics_base_port 0 has no fixed addresses")
+        return (self.host, self.metrics_base_port + nid)
 
 
 def generate_infos(cfg: ClusterConfig) -> Dict[int, NetworkInfo]:
@@ -119,6 +128,7 @@ class LocalCluster:
         self.runtime_kwargs = runtime_kwargs
         self.runtimes: List[NodeRuntime] = []
         self.addrs: Dict[int, Addr] = {}
+        self.metrics_addrs: Dict[int, Addr] = {}
         self._clients: List[ClusterClient] = []
 
     async def start(self) -> None:
@@ -129,6 +139,11 @@ class LocalCluster:
         ]
         for nid, rt in enumerate(self.runtimes):
             self.addrs[nid] = await rt.start(self.cfg.host, 0)
+            self.metrics_addrs[nid] = await rt.start_obs(
+                self.cfg.host,
+                (self.cfg.metrics_base_port + nid
+                 if self.cfg.metrics_base_port else 0),
+            )
         for rt in self.runtimes:
             rt.connect(self.addrs)
 
@@ -222,6 +237,8 @@ def node_command(cfg: ClusterConfig, nid: int) -> List[str]:
         "--base-port", str(cfg.base_port),
         "--batch-size", str(cfg.batch_size),
     ]
+    if cfg.metrics_base_port:
+        cmd += ["--metrics-port", str(cfg.metrics_base_port + nid)]
     if cfg.encrypt:
         cmd.append("--encrypt")
     return cmd
@@ -273,12 +290,17 @@ def shutdown_procs(procs, timeout_s: float = 15.0) -> None:
             p.kill()
 
 
-async def run_node(cfg: ClusterConfig, nid: int) -> None:
+async def run_node(cfg: ClusterConfig, nid: int,
+                   metrics_port: int = 0) -> None:
     """Run one node forever (the subprocess entry body)."""
     infos = generate_infos(cfg)
     rt = build_runtime(cfg, infos, nid)
     host, port = cfg.addr(nid)
     await rt.start(host, port)
+    if metrics_port:
+        m_host, m_port = await rt.start_obs(host, metrics_port)
+        print(f"node {nid} obs endpoint on http://{m_host}:{m_port}"
+              f"/metrics", flush=True)
     rt.connect(cfg.addr_map())
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -300,6 +322,9 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--base-port", type=int, required=True)
     ap.add_argument("--batch-size", type=int, default=8)
     ap.add_argument("--encrypt", action="store_true")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="serve /metrics /status /spans on this port "
+                         "(0 = off)")
     args = ap.parse_args(argv)
     if not 0 <= args.node_id < args.nodes:
         ap.error(f"--node-id {args.node_id} not in 0..{args.nodes - 1}")
@@ -307,7 +332,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         n=args.nodes, seed=args.seed, base_port=args.base_port,
         batch_size=args.batch_size, encrypt=args.encrypt,
     )
-    asyncio.run(run_node(cfg, args.node_id))
+    asyncio.run(run_node(cfg, args.node_id,
+                         metrics_port=args.metrics_port))
 
 
 if __name__ == "__main__":

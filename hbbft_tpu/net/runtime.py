@@ -39,6 +39,9 @@ from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
 from hbbft_tpu.net import framing
 from hbbft_tpu.net.client import Mempool, tx_digest
 from hbbft_tpu.net.transport import ClientConn, Transport
+from hbbft_tpu.obs.http import ObsServer
+from hbbft_tpu.obs.metrics import MetricAttr, Registry, fault_counter
+from hbbft_tpu.obs.spans import SpanTracer
 from hbbft_tpu.protocols import wire
 from hbbft_tpu.protocols.dynamic_honey_badger import DhbBatch
 from hbbft_tpu.protocols.honey_badger import Batch as HbBatch
@@ -106,10 +109,31 @@ class NodeRuntime:
         on_batch: Optional[Callable[[Any], None]] = None,
         trace=None,
         cost_model=None,
+        registry: Optional[Registry] = None,
         **transport_kwargs,
     ):
         self.sq = algo if isinstance(algo, SenderQueue) else SenderQueue(algo)
+        # one registry per node: every layer below (transport, mempool,
+        # span tracer, fault tallies) registers onto it, and /metrics
+        # exposes it live (see hbbft_tpu.obs)
+        self.registry = registry or Registry()
+        self.spans = SpanTracer(self.registry, node=self.sq.our_id())
+        self._c_decode = self.registry.counter(
+            "hbbft_node_decode_failures_total",
+            "undecodable or protocol-rejected peer messages")
+        self._c_send_fail = self.registry.counter(
+            "hbbft_node_send_failures_total",
+            "outbound frames dropped (frame cap)")
+        self._c_replay_gaps = self.registry.counter(
+            "hbbft_node_replay_gaps_total",
+            "peer restarts whose gap exceeded replay retention "
+            "(the peer cannot catch up from here)")
+        self._c_committed = self.registry.counter(
+            "hbbft_node_committed_txs_total", "transactions committed")
+        self._c_faults = fault_counter(self.registry)
+        self.registry.register_callback(self._refresh_gauges)
         self.mempool = mempool or Mempool()
+        self.mempool.bind_registry(self.registry)
         # the oversized-frame drop in _dispatch is a last-resort guard,
         # not a config escape hatch: a proposal of batch_size max-size txs
         # must fit the wire blob cap with margin (TLV + TPKE overhead),
@@ -130,11 +154,6 @@ class NodeRuntime:
         self.batches: List[Any] = []
         self.ledger_digest = b"\x00" * 32
         self.digest_chain: List[str] = []
-        self.committed_txs = 0
-        self.decode_failures = 0
-        self.send_failures = 0
-        self.replay_gaps = 0
-        self.faults_observed = 0
         # per-peer replay log of recently sent consensus messages, in send
         # order: the reinit_peer history (see module docstring).  The
         # companion set dedups by value so reinit re-sends don't duplicate
@@ -153,8 +172,72 @@ class NodeRuntime:
             on_client_gone=self._on_client_gone,
             trace=trace,
             cost_model=cost_model,
+            registry=self.registry,
             **transport_kwargs,
         )
+        self._obs_server: Optional[ObsServer] = None
+        self.obs_addr: Optional[Addr] = None
+
+    # -- observability -------------------------------------------------------
+    #
+    # The pre-registry integer attributes survive as thin counter-backed
+    # views (MetricAttr descriptors) so existing call sites — status_doc
+    # consumers, tests — keep working; the registry is the single source
+    # of truth.
+
+    committed_txs = MetricAttr("_c_committed")
+    decode_failures = MetricAttr("_c_decode")
+    send_failures = MetricAttr("_c_send_fail")
+    replay_gaps = MetricAttr("_c_replay_gaps")
+
+    @property
+    def faults_observed(self) -> int:
+        return int(self._c_faults.total())
+
+    def _refresh_gauges(self) -> None:
+        """Derived-state gauges, refreshed on every scrape: consensus
+        position, ledger length, connection health, and the replay/catch-up
+        surfaces PR 2 only logged — replay-log depth and each peer's
+        last-acked (era, epoch) — now scrapeable instead of grep-able."""
+        r = self.registry
+        era, epoch = self.current_key()
+        r.gauge("hbbft_node_era", "current consensus era").set(era)
+        r.gauge("hbbft_node_epoch", "current epoch within the era").set(epoch)
+        r.gauge("hbbft_node_batches", "batches committed so far").set(
+            len(self.batches))
+        r.gauge("hbbft_node_peers_connected",
+                "peers with a live outbound connection").set(sum(
+                    1 for p in self.transport.peer_ids()
+                    if self.transport.connected(p)))
+        g_replay = r.gauge(
+            "hbbft_node_replay_log_entries",
+            "retained replay-log messages per peer", labelnames=("peer",))
+        for peer, entries in self._replay.items():
+            g_replay.labels(peer=repr(peer)).set(len(entries))
+        g_pera = r.gauge(
+            "hbbft_node_peer_era",
+            "last (era, epoch) each peer announced: era part",
+            labelnames=("peer",))
+        g_pep = r.gauge(
+            "hbbft_node_peer_epoch",
+            "last (era, epoch) each peer announced: epoch part",
+            labelnames=("peer",))
+        for peer, (p_era, p_epoch) in self.sq.peer_epochs.items():
+            if peer == self.our_id():
+                continue
+            g_pera.labels(peer=repr(peer)).set(p_era)
+            g_pep.labels(peer=repr(peer)).set(p_epoch)
+
+    async def start_obs(self, host: str = "127.0.0.1",
+                        port: int = 0) -> Addr:
+        """Serve ``/metrics``, ``/status``, ``/spans`` (see obs.http)."""
+        self._obs_server = ObsServer(
+            self.registry,
+            status_fn=self.status_doc,
+            spans_fn=self.spans.export_jsonl,
+        )
+        self.obs_addr = await self._obs_server.start(host, port)
+        return self.obs_addr
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -175,6 +258,8 @@ class NodeRuntime:
         self._absorb(self.sq.startup_step())
 
     async def stop(self) -> None:
+        if self._obs_server is not None:
+            await self._obs_server.stop()
         await self.transport.stop()
 
     # -- consensus plumbing --------------------------------------------------
@@ -198,6 +283,7 @@ class NodeRuntime:
             logger.warning("non-sender-queue message %s from %r",
                            type(msg).__name__, peer_id)
             return
+        self.spans.on_message(peer_id, msg)
         try:
             step = self.sq.handle_message(peer_id, msg)
         except TypeError as exc:
@@ -248,7 +334,9 @@ class NodeRuntime:
         self._absorb(self.sq.reinit_peer(peer_id, key, history))
 
     def _absorb(self, step: Step) -> None:
-        self.faults_observed += len(step.fault_log)
+        for fault in step.fault_log:
+            self._c_faults.labels(kind=fault.kind.name).inc()
+        self.spans.on_step(step)
         for out in step.output:
             if isinstance(out, (QhbBatch, DhbBatch, HbBatch)):
                 self._on_batch(out)
@@ -311,7 +399,7 @@ class NodeRuntime:
         self.digest_chain.append(self.ledger_digest.hex())
         if isinstance(batch, QhbBatch):
             txs = batch.all_txs()
-            self.committed_txs += len(txs)
+            self._c_committed.inc(len(txs))
             digests = self.mempool.mark_committed(txs)
             self._notify_commit(batch.era, batch.epoch, digests)
         if self.on_batch is not None:
@@ -366,5 +454,7 @@ class NodeRuntime:
                 1 for p in self.transport.peer_ids()
                 if self.transport.connected(p)
             ),
+            "epochs_traced": self.spans.epochs_finalized,
+            "obs_addr": list(self.obs_addr) if self.obs_addr else None,
             "stats": self.transport.stats.as_dict(),
         }

@@ -52,10 +52,10 @@ import random
 import struct
 import time
 from collections import deque
-from dataclasses import dataclass, field
 from typing import Any, Callable, Deque, Dict, Hashable, List, Optional, Tuple
 
 from hbbft_tpu.net import framing
+from hbbft_tpu.obs.metrics import MetricAttr
 from hbbft_tpu.net.framing import (
     DEFAULT_MAX_FRAME,
     FrameDecoder,
@@ -107,20 +107,107 @@ class BackoffPolicy:
         return [self.delay(i, rng) for i in range(n)]
 
 
-@dataclass
+class _LabeledCounterView:
+    """Dict-shaped view over one labeled counter, keyed by the original
+    (hashable) id — the shim that lets ``stats.reconnects[peer] += 1``-style
+    call sites keep working while the registry carries the series.
+
+    The view keeps its own per-key values and applies *deltas* to the
+    counter: past the metric's label-cardinality cap several keys share
+    the ``_overflow_`` series, and a plain assignment there would clobber
+    every other overflowed peer's aggregate — a delta only ever adds this
+    key's change."""
+
+    def __init__(self, counter):
+        self._counter = counter
+        self._values: Dict[NodeId, float] = {}
+
+    def get(self, key: NodeId, default: int = 0) -> int:
+        return int(self._values.get(key, default))
+
+    def __getitem__(self, key: NodeId) -> int:
+        return int(self._values[key])
+
+    def __setitem__(self, key: NodeId, value: int) -> None:
+        self._counter.labels(repr(key)).inc(
+            value - self._values.get(key, 0)
+        )
+        self._values[key] = value
+
+    def __contains__(self, key: NodeId) -> bool:
+        return key in self._values
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def items(self):
+        return [(k, int(v)) for k, v in self._values.items()]
+
+    def keys(self):
+        return list(self._values.keys())
+
+    def __iter__(self):
+        return iter(self.keys())
+
+
 class TransportStats:
-    frames_sent: int = 0
-    bytes_sent: int = 0
-    frames_recv: int = 0
-    bytes_recv: int = 0
-    reconnects: Dict[NodeId, int] = field(default_factory=dict)
-    backoff_delays: Dict[NodeId, List[float]] = field(default_factory=dict)
-    send_queue_peak: int = 0
-    dead_peer_events: int = 0
-    # virtual cost of received traffic under the attached CostModel — the
-    # simulator's synthetic clock applied to real frames, so sim and net
-    # runs report comparable virtual time
-    virtual_cost_s: float = 0.0
+    """Socket-layer counters, backed by an :mod:`hbbft_tpu.obs.metrics`
+    registry (``hbbft_net_*``); the original dataclass attribute API is
+    preserved as thin property views so no call site or test breaks.
+    ``backoff_delays`` keeps the exact per-peer delay *lists* (the seeded
+    determinism tests assert on the sequences, which a histogram cannot
+    represent); :meth:`record_backoff` also feeds the registry histogram."""
+
+    def __init__(self, registry=None):
+        from hbbft_tpu.obs.metrics import Registry
+
+        self.registry = registry or Registry()
+        r = self.registry
+        self._frames_sent = r.counter(
+            "hbbft_net_frames_sent_total",
+            "frames written to peer/client sockets")
+        self._bytes_sent = r.counter(
+            "hbbft_net_bytes_sent_total",
+            "framed bytes written, length prefix included")
+        self._frames_recv = r.counter(
+            "hbbft_net_frames_recv_total", "frames received")
+        self._bytes_recv = r.counter(
+            "hbbft_net_bytes_recv_total", "framed bytes received")
+        self._reconnects = r.counter(
+            "hbbft_net_reconnects_total",
+            "outbound connection losses per peer", labelnames=("peer",))
+        self._send_queue_peak = r.gauge(
+            "hbbft_net_send_queue_peak",
+            "high-water mark of any per-peer outbox")
+        self._dead_peer_events = r.counter(
+            "hbbft_net_dead_peer_events_total",
+            "peers declared dead after missed heartbeats")
+        # virtual cost of received traffic under the attached CostModel —
+        # the simulator's synthetic clock applied to real frames, so sim
+        # and net runs report comparable virtual time
+        self._virtual_cost = r.counter(
+            "hbbft_net_virtual_cost_seconds_total",
+            "CostModel virtual seconds charged to received frames")
+        self._backoff_hist = r.histogram(
+            "hbbft_net_backoff_delay_seconds",
+            "reconnect backoff delays drawn",
+            buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0))
+        self.reconnects = _LabeledCounterView(self._reconnects)
+        self.backoff_delays: Dict[NodeId, List[float]] = {}
+
+    # -- attribute views (the pre-registry dataclass API) -------------------
+
+    frames_sent = MetricAttr("_frames_sent")
+    bytes_sent = MetricAttr("_bytes_sent")
+    frames_recv = MetricAttr("_frames_recv")
+    bytes_recv = MetricAttr("_bytes_recv")
+    send_queue_peak = MetricAttr("_send_queue_peak")
+    dead_peer_events = MetricAttr("_dead_peer_events")
+    virtual_cost_s = MetricAttr("_virtual_cost", cast=float)
+
+    def record_backoff(self, peer_id: NodeId, delay: float) -> None:
+        self.backoff_delays.setdefault(peer_id, []).append(delay)
+        self._backoff_hist.observe(delay)
 
     def as_dict(self) -> Dict[str, Any]:
         return {
@@ -247,7 +334,7 @@ class _PeerSender:
 
     async def _backoff(self, attempt: int) -> int:
         delay = self.t.backoff.delay(attempt, self.rng)
-        self.t.stats.backoff_delays.setdefault(self.peer_id, []).append(delay)
+        self.t.stats.record_backoff(self.peer_id, delay)
         await asyncio.sleep(delay)
         return attempt + 1
 
@@ -401,6 +488,7 @@ class Transport:
         backoff: Optional[BackoffPolicy] = None,
         trace=None,
         cost_model=None,
+        registry=None,
     ):
         self.our_id = our_id
         self.cluster_id = bytes(cluster_id)
@@ -417,7 +505,7 @@ class Transport:
         self.backoff = backoff or BackoffPolicy(seed=seed)
         self.trace = trace
         self.cost_model = cost_model
-        self.stats = TransportStats()
+        self.stats = TransportStats(registry)
         self._senders: Dict[NodeId, _PeerSender] = {}
         self._server: Optional[asyncio.base_events.Server] = None
         self._inbound_tasks: set = set()

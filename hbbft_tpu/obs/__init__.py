@@ -1,0 +1,44 @@
+"""Unified observability: metrics registry, epoch-phase spans, exposition.
+
+The layer the ROADMAP's "production-scale, heavy traffic" north star needs
+before any further perf PR can be honestly measured: "The Latency Price of
+Threshold Cryptosystems" (PAPERS.md) shows that phase attribution — where
+inside an epoch the latency goes (RBC echo fan-out? ABA coin flips? TPKE
+decrypt-share combine?) — dominates threshold-crypto BFT analysis, and
+Thetacrypt treats a built-in metrics service as table stakes.
+
+- :mod:`hbbft_tpu.obs.metrics` — dependency-free labeled
+  Counter/Gauge/Histogram registry with Prometheus-text and JSON exposition
+  (naming convention ``hbbft_<layer>_<name>``, enforced by
+  ``tools_check_metrics.py`` in tier 1);
+- :mod:`hbbft_tpu.obs.spans` — the epoch-phase tracer protocols report into
+  via the :class:`hbbft_tpu.traits.StepObserver` hook: per-epoch spans for
+  RBC Value/Echo/Ready, per-ABA-round BVal/Aux/Conf + coin, threshold-decrypt
+  share/combine, and DKG rotation, exportable as JSONL;
+- :mod:`hbbft_tpu.obs.http` — the asyncio ``/metrics``, ``/status``,
+  ``/spans`` endpoint every :class:`~hbbft_tpu.net.runtime.NodeRuntime`
+  serves;
+- :mod:`hbbft_tpu.obs.top` — ``python -m hbbft_tpu.obs.top``, a curses-free
+  live cluster view polling all nodes.
+"""
+
+from hbbft_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    histogram_quantile,
+    parse_prometheus_text,
+)
+from hbbft_tpu.obs.spans import Span, SpanTracer
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "Span",
+    "SpanTracer",
+    "histogram_quantile",
+    "parse_prometheus_text",
+]

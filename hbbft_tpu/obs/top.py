@@ -1,0 +1,174 @@
+"""``python -m hbbft_tpu.obs.top`` — curses-free live cluster view.
+
+Polls every node's obs endpoint (``/status`` + ``/metrics``), and renders a
+refreshing plain-ANSI table: per-node era/epoch/batches, live epoch rate
+(batches delta over the poll interval), mempool depth, connected peers,
+fault and decode counters — plus the cluster-aggregated per-phase p50/p99
+(from the ``hbbft_phase_duration_seconds`` histograms, buckets summed
+across nodes), which is the "where does the epoch latency go" line.
+
+    python -m hbbft_tpu.obs.top --targets 127.0.0.1:26000,127.0.0.1:26001
+    python -m hbbft_tpu.obs.top --base-port 26000 --nodes 4
+
+``--iterations N`` renders N frames then exits (``1`` = one plain snapshot,
+used by scripts/tests); the default runs until interrupted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from hbbft_tpu.obs.http import http_get
+from hbbft_tpu.obs.metrics import histogram_quantile, parse_prometheus_text
+
+Target = Tuple[str, int]
+
+#: phase rows shown in the breakdown, in protocol order
+TOP_PHASES = (
+    "rbc_value", "rbc_echo", "rbc_ready", "aba_bval", "aba_aux",
+    "aba_conf", "aba_coin", "decrypt_share", "decrypt_combine",
+    "dkg_rotation",
+)
+
+
+def poll_target(host: str, port: int, timeout_s: float = 2.0
+                ) -> Optional[dict]:
+    """One node's ``{"status":…, "metrics":…}`` snapshot, None if down."""
+    try:
+        status = http_get(host, port, "/status", timeout_s)
+        metrics = http_get(host, port, "/metrics", timeout_s)
+    except (OSError, ValueError):
+        return None
+    import json
+
+    try:
+        return {
+            "status": json.loads(status),
+            "metrics": parse_prometheus_text(metrics),
+        }
+    except ValueError:
+        return None
+
+
+def phase_quantiles(snaps: List[Optional[dict]],
+                    qs=(0.5, 0.99)) -> Dict[str, List[float]]:
+    """Cluster-wide per-phase quantiles: histogram buckets summed over
+    nodes, then interpolated."""
+    acc: Dict[str, Dict[float, float]] = {}
+    for snap in snaps:
+        if snap is None:
+            continue
+        series = snap["metrics"].get(
+            "hbbft_phase_duration_seconds_bucket", []
+        )
+        for labels, value in series:
+            phase = labels.get("phase", "?")
+            le = float("inf") if labels.get("le") == "+Inf" else float(
+                labels.get("le", "inf")
+            )
+            by_le = acc.setdefault(phase, {})
+            by_le[le] = by_le.get(le, 0.0) + value
+    out: Dict[str, List[float]] = {}
+    for phase, by_le in acc.items():
+        cum = sorted(by_le.items())
+        out[phase] = [histogram_quantile(cum, q) for q in qs]
+    return out
+
+
+def render(targets: List[Target], prev: List[Optional[dict]],
+           cur: List[Optional[dict]], dt: float) -> str:
+    lines: List[str] = []
+    lines.append(
+        f"hbbft-tpu obs.top — {len(targets)} nodes — "
+        f"{time.strftime('%H:%M:%S')}  (poll {dt:.1f}s)"
+    )
+    lines.append(
+        f"{'node':<22} {'era':>4} {'epoch':>6} {'batch':>6} "
+        f"{'ep/s':>6} {'mempool':>8} {'peers':>5} {'txs':>8} "
+        f"{'faults':>6} {'decode!':>7} {'gaps':>5}"
+    )
+    for i, (host, port) in enumerate(targets):
+        snap = cur[i]
+        name = f"{host}:{port}"
+        if snap is None:
+            lines.append(f"{name:<22} DOWN")
+            continue
+        d = snap["status"]
+        rate = ""
+        if prev[i] is not None and dt > 0:
+            rate = "%.2f" % (
+                (d["batches"] - prev[i]["status"]["batches"]) / dt
+            )
+        lines.append(
+            f"{name:<22} {d['era']:>4} {d['epoch']:>6} "
+            f"{d['batches']:>6} {rate:>6} {d['mempool']:>8} "
+            f"{d['peers_connected']:>5} {d['committed_txs']:>8} "
+            f"{d['faults_observed']:>6} {d['decode_failures']:>7} "
+            f"{d['replay_gaps']:>5}"
+        )
+    pq = phase_quantiles(cur)
+    lines.append("")
+    lines.append(f"{'phase':<18} {'p50 ms':>9} {'p99 ms':>9}")
+    for phase in TOP_PHASES:
+        if phase not in pq:
+            continue
+        p50, p99 = pq[phase]
+        lines.append(f"{phase:<18} {p50 * 1e3:>9.2f} {p99 * 1e3:>9.2f}")
+    if not pq:
+        lines.append("(no finished epochs yet)")
+    return "\n".join(lines)
+
+
+def parse_targets(args) -> List[Target]:
+    if args.targets:
+        out = []
+        for part in args.targets.split(","):
+            host, _, port = part.strip().rpartition(":")
+            out.append((host or "127.0.0.1", int(port)))
+        return out
+    if args.base_port:
+        return [("127.0.0.1", args.base_port + i)
+                for i in range(args.nodes)]
+    raise SystemExit("need --targets or --base-port/--nodes")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--targets", default="",
+                    help="comma-separated host:port obs endpoints")
+    ap.add_argument("--base-port", type=int, default=0,
+                    help="metrics base port (node i at base+i)")
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--iterations", type=int, default=0,
+                    help="0 = run until interrupted; 1 = one snapshot")
+    args = ap.parse_args(argv)
+    targets = parse_targets(args)
+
+    clear = (sys.stdout.isatty() and args.iterations != 1)
+    prev: List[Optional[dict]] = [None] * len(targets)
+    t_prev = time.monotonic()
+    i = 0
+    try:
+        while True:
+            cur = [poll_target(h, p) for h, p in targets]
+            now = time.monotonic()
+            frame = render(targets, prev, cur, now - t_prev)
+            if clear:
+                sys.stdout.write("\x1b[H\x1b[2J")
+            print(frame, flush=True)
+            prev, t_prev = cur, now
+            i += 1
+            if args.iterations and i >= args.iterations:
+                break
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0 if any(s is not None for s in prev) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

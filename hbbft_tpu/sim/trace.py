@@ -53,10 +53,34 @@ class NetEvent:
 
 @dataclass
 class EventLog:
-    """Append-only per-crank event records with summary accessors."""
+    """Append-only per-crank event records with summary accessors.
+
+    Net-frame counters live on an :mod:`hbbft_tpu.obs.metrics` registry
+    (``hbbft_sim_net_*``, labeled kind × direction) — the by-kind accessor
+    methods are thin views over those counters, and attaching a node's
+    registry (``registry=``) makes the log's tallies scrapeable alongside
+    that node's other metrics.  The raw event lists are retained for
+    detailed queries."""
 
     events: List[CrankEvent] = field(default_factory=list)
     net_events: List[NetEvent] = field(default_factory=list)
+    registry: Optional[Any] = None
+
+    def __post_init__(self):
+        if self.registry is None:
+            from hbbft_tpu.obs.metrics import Registry
+
+            self.registry = Registry()
+        self._c_net_frames = self.registry.counter(
+            "hbbft_sim_net_frames_total",
+            "real-transport frames recorded by the event log",
+            labelnames=("kind", "direction"),
+        )
+        self._c_net_bytes = self.registry.counter(
+            "hbbft_sim_net_bytes_total",
+            "framed bytes recorded by the event log",
+            labelnames=("kind", "direction"),
+        )
 
     def record(self, ev: CrankEvent) -> None:
         self.events.append(ev)
@@ -68,28 +92,33 @@ class EventLog:
 
     def record_net(self, ev: NetEvent) -> None:
         self.net_events.append(ev)
+        self._c_net_frames.labels(kind=ev.kind,
+                                  direction=ev.direction).inc()
+        self._c_net_bytes.labels(kind=ev.kind,
+                                 direction=ev.direction).inc(ev.wire_bytes)
         logger.debug(
             "net %s %s %s (%dB)", ev.direction, ev.peer, ev.kind,
             ev.wire_bytes,
         )
 
-    def net_frames_by_kind(self) -> Dict[str, int]:
+    def _sum_series(self, counter, direction: Optional[str] = None
+                    ) -> Dict[str, int]:
         out: Dict[str, int] = {}
-        for ev in self.net_events:
-            out[ev.kind] = out.get(ev.kind, 0) + 1
+        for labels, child in counter.series():
+            if direction is not None and labels["direction"] != direction:
+                continue
+            k = labels["kind"]
+            out[k] = out.get(k, 0) + int(child.get())
         return out
+
+    def net_frames_by_kind(self) -> Dict[str, int]:
+        return self._sum_series(self._c_net_frames)
 
     def net_bytes_by_kind(self) -> Dict[str, int]:
-        out: Dict[str, int] = {}
-        for ev in self.net_events:
-            out[ev.kind] = out.get(ev.kind, 0) + ev.wire_bytes
-        return out
+        return self._sum_series(self._c_net_bytes)
 
     def net_total_bytes(self, direction: Optional[str] = None) -> int:
-        return sum(
-            ev.wire_bytes for ev in self.net_events
-            if direction is None or ev.direction == direction
-        )
+        return sum(self._sum_series(self._c_net_bytes, direction).values())
 
     def messages_by_type(self) -> Dict[str, int]:
         out: Dict[str, int] = {}
@@ -173,15 +202,40 @@ class CostModel:
         )
 
 
+_wire_size_failed_types: set = set()
+
+
 def wire_size(payload: Any) -> int:
-    """Canonical wire size of a protocol message (0 if not encodable)."""
+    """Canonical wire size of a protocol message.
+
+    An encode failure still returns 0 (the crank loop must not die on an
+    unencodable adversarial payload), but it is no longer silent: every
+    failure increments ``hbbft_sim_wire_size_failures_total`` (labeled by
+    the nested type path, on the process-wide default registry) and the
+    offending type path is logged once — so EventLog byte totals can't
+    under-report without leaving a trace."""
     import struct
 
     from hbbft_tpu.protocols import wire
 
     try:
         return len(wire.encode_message(payload))
-    except (TypeError, ValueError, struct.error):
+    except (TypeError, ValueError, struct.error) as exc:
+        from hbbft_tpu.obs.metrics import DEFAULT
+
+        path = msg_type_path(payload)
+        DEFAULT.counter(
+            "hbbft_sim_wire_size_failures_total",
+            "messages whose wire size could not be computed "
+            "(byte totals under-report by these)",
+            labelnames=("type",),
+        ).labels(type=path).inc()
+        if path not in _wire_size_failed_types:
+            _wire_size_failed_types.add(path)
+            logger.warning(
+                "wire_size: cannot encode %s (%s) — counting as 0 bytes; "
+                "EventLog byte totals under-report this type", path, exc,
+            )
         return 0
 
 

@@ -52,6 +52,7 @@ class VirtualNet:
         crank_limit: Optional[int] = None,
         trace: Optional["EventLog"] = None,
         cost_model: Optional["CostModel"] = None,
+        observers: Optional[Dict[NodeId, Any]] = None,
     ):
         self.nodes = nodes
         self.queue: List[NetworkMessage] = []
@@ -62,6 +63,9 @@ class VirtualNet:
         self.cranks = 0
         self.trace = trace
         self.cost_model = cost_model
+        # per-node traits.StepObserver hooks (e.g. obs.spans.SpanTracer):
+        # each delivery/input to node i is reported to observers[i]
+        self.observers: Dict[NodeId, Any] = observers or {}
         # per-node clocks: nodes work in parallel, so simulated wall time is
         # the max over nodes, not the sum (mirrors the reference example's
         # per-node timing model)
@@ -82,6 +86,9 @@ class VirtualNet:
         """Feed an input to a node and fan out its step."""
         node = self.nodes[node_id]
         step = node.algorithm.handle_input(input)
+        obs = self.observers.get(node_id)
+        if obs is not None:
+            obs.on_step(step)
         self._process_step(node, step)
 
     def crank(self) -> Optional[NetworkMessage]:
@@ -96,7 +103,12 @@ class VirtualNet:
         dest = self.nodes.get(msg.to)
         if dest is None:
             return msg
+        obs = self.observers.get(msg.to)
+        if obs is not None:
+            obs.on_message(msg.sender, msg.payload)
         step = dest.algorithm.handle_message(msg.sender, msg.payload)
+        if obs is not None:
+            obs.on_step(step)
         self._process_step(dest, step)
         self.messages_delivered += 1
         if self.trace is not None or self.cost_model is not None:
@@ -174,6 +186,7 @@ class NetBuilder:
         self._crank_limit: Optional[int] = None
         self._trace = None
         self._cost_model = None
+        self._observer_factory: Optional[Callable[[NodeId], Any]] = None
 
     def faulty(self, ids: Sequence[NodeId]) -> "NetBuilder":
         self._faulty = set(ids)
@@ -206,6 +219,13 @@ class NetBuilder:
         self._cost_model = model
         return self
 
+    def observe(self, factory: Callable[[NodeId], Any]) -> "NetBuilder":
+        """Attach one :class:`hbbft_tpu.traits.StepObserver` per node —
+        ``factory(node_id)`` builds it (e.g. an ``obs.spans.SpanTracer``);
+        the built observers are reachable as ``net.observers[node_id]``."""
+        self._observer_factory = factory
+        return self
+
     def using_step(self, make_algo: Callable[[NodeId], Any]) -> VirtualNet:
         nodes = {
             nid: Node(
@@ -222,4 +242,8 @@ class NetBuilder:
             crank_limit=self._crank_limit,
             trace=self._trace,
             cost_model=self._cost_model,
+            observers=(
+                {nid: self._observer_factory(nid) for nid in self.ids}
+                if self._observer_factory is not None else None
+            ),
         )

@@ -193,6 +193,28 @@ class Step(Generic[M, O]):
         )
 
 
+class StepObserver:
+    """Observability hook threaded through :class:`Step` processing.
+
+    The protocols stay sans-I/O: they never call this themselves.  Every
+    driver that pumps Steps — ``sim.virtual_net.VirtualNet`` per delivery,
+    ``net.runtime.NodeRuntime`` per socket message — reports each inbound
+    message and the resulting Step through one of these, which is how the
+    epoch-phase tracer (``obs.spans.SpanTracer``) attributes wall-clock time
+    to RBC/ABA/coin/decrypt/DKG phases without touching protocol code.
+
+    Both methods are optional no-ops; ``t`` is a monotonic timestamp the
+    driver may supply (the observer stamps its own clock when omitted).
+    """
+
+    def on_message(self, sender_id: NodeId, message: Any,
+                   t: Optional[float] = None) -> None:
+        """One inbound protocol message, before it is handled."""
+
+    def on_step(self, step: "Step", t: Optional[float] = None) -> None:
+        """The Step the protocol returned (outputs close epochs)."""
+
+
 class ConsensusProtocol(abc.ABC, Generic[M, O]):
     """Abstract sans-I/O consensus state machine.
 

@@ -1,0 +1,191 @@
+"""Metrics registry invariants + the static metrics-contract checker.
+
+The registry is the one piece every observability surface trusts, so its
+invariants get direct coverage: label cardinality capping, histogram
+bucket monotonicity, Prometheus text escaping (round-tripped through the
+shipped parser), get-or-create semantics, and quantile estimation."""
+
+import math
+
+import pytest
+
+from hbbft_tpu.obs.metrics import (
+    DEFAULT_BUCKETS,
+    OVERFLOW,
+    Registry,
+    escape_help,
+    escape_label_value,
+    fault_counter,
+    histogram_quantile,
+    parse_prometheus_text,
+)
+
+
+def test_counter_gauge_basics_and_json():
+    r = Registry()
+    c = r.counter("hbbft_node_x_total", "x")
+    c.inc()
+    c.inc(2.5)
+    assert c.value() == 3.5
+    g = r.gauge("hbbft_node_g", "g")
+    g.set(7)
+    g.dec(2)
+    assert g.value() == 5
+    doc = r.as_dict()
+    assert doc["hbbft_node_x_total"]["type"] == "counter"
+    assert doc["hbbft_node_x_total"]["series"][0]["value"] == 3.5
+    assert doc["hbbft_node_g"]["series"][0]["value"] == 5
+
+
+def test_registration_is_get_or_create_and_kind_conflicts_raise():
+    r = Registry()
+    a = r.counter("hbbft_node_a_total", "a", labelnames=("k",))
+    b = r.counter("hbbft_node_a_total", "ignored", labelnames=("k",))
+    assert a is b
+    with pytest.raises(ValueError):
+        r.gauge("hbbft_node_a_total", "now a gauge?")
+    with pytest.raises(ValueError):
+        r.counter("hbbft_node_a_total", "other labels", labelnames=("x",))
+    with pytest.raises(ValueError):
+        r.counter("1bad name", "invalid identifier")
+
+
+def test_label_cardinality_cap_collapses_into_overflow():
+    r = Registry()
+    c = r.counter("hbbft_node_peers_total", "p", labelnames=("peer",),
+                  max_label_sets=4)
+    for i in range(10):
+        c.labels(peer=f"p{i}").inc()
+    # 4 real series; the 6 overflowing label sets all landed on the
+    # sentinel series and were counted as dropped
+    series = dict(
+        (labels["peer"], child.get()) for labels, child in c.series()
+    )
+    assert len(series) == 5  # 4 real + the overflow series
+    assert series[OVERFLOW] == 6
+    assert r.dropped_label_sets == 6
+    # total is conserved
+    assert sum(series.values()) == 10
+
+
+def test_histogram_reregistration_with_different_buckets_raises():
+    r = Registry()
+    r.histogram("hbbft_node_hb_seconds", "h", buckets=(0.01, 0.1))
+    with pytest.raises(ValueError):
+        r.histogram("hbbft_node_hb_seconds", "h", buckets=(1.0, 10.0))
+    # same buckets → same metric back
+    h = r.histogram("hbbft_node_hb_seconds", "h", buckets=(0.01, 0.1))
+    assert h.buckets == (0.01, 0.1)
+
+
+def test_unlabeled_metrics_always_expose_a_zero_sample():
+    """A scraper must distinguish '0 so far' from 'metric absent': a
+    counter that was never incremented still renders a sample line (the
+    bug the verify drive caught on a fresh restarted node)."""
+    r = Registry()
+    r.counter("hbbft_node_replay_gaps_total", "never incremented")
+    parsed = parse_prometheus_text(r.render_prometheus())
+    assert parsed["hbbft_node_replay_gaps_total"] == [({}, 0.0)]
+
+
+def test_histogram_buckets_must_be_strictly_increasing():
+    r = Registry()
+    with pytest.raises(ValueError):
+        r.histogram("hbbft_node_h1_seconds", "h", buckets=(1.0, 1.0, 2.0))
+    with pytest.raises(ValueError):
+        r.histogram("hbbft_node_h2_seconds", "h", buckets=(2.0, 1.0))
+    with pytest.raises(ValueError):
+        r.histogram("hbbft_node_h3_seconds", "h", buckets=())
+    # a trailing +Inf is tolerated (it is implicit)
+    h = r.histogram("hbbft_node_h4_seconds", "h",
+                    buckets=(0.1, 1.0, math.inf))
+    assert h.buckets == (0.1, 1.0)
+
+
+def test_histogram_observe_render_and_quantile():
+    r = Registry()
+    h = r.histogram("hbbft_phase_duration_seconds", "p",
+                    labelnames=("phase",), buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5):
+        h.labels(phase="rbc_echo").observe(v)
+    text = r.render_prometheus()
+    parsed = parse_prometheus_text(text)
+    buckets = {
+        labels["le"]: v
+        for labels, v in parsed["hbbft_phase_duration_seconds_bucket"]
+    }
+    assert buckets["0.01"] == 1 and buckets["0.1"] == 3
+    assert buckets["1"] == 4 and buckets["+Inf"] == 4
+    assert parsed["hbbft_phase_duration_seconds_count"][0][1] == 4
+    q = h.labels(phase="rbc_echo").quantile(0.5)
+    assert 0.01 < q <= 0.1
+
+
+def test_histogram_quantile_interpolation_and_edges():
+    cum = [(0.1, 0), (1.0, 10), (math.inf, 10)]
+    assert histogram_quantile(cum, 0.5) == pytest.approx(0.55)
+    assert histogram_quantile(cum, 1.0) == pytest.approx(1.0)
+    # all mass in +Inf reports the highest finite bound
+    assert histogram_quantile([(0.1, 0), (math.inf, 5)], 0.5) == 0.1
+    assert math.isnan(histogram_quantile([], 0.5))
+    assert math.isnan(histogram_quantile([(0.1, 0), (math.inf, 0)], 0.5))
+
+
+def test_prometheus_text_escaping_round_trips():
+    r = Registry()
+    c = r.counter("hbbft_node_esc_total", 'help with \\ backslash\nand "',
+                  labelnames=("who",))
+    tricky = 'a"b\\c\nd'
+    c.labels(who=tricky).inc(2)
+    text = r.render_prometheus()
+    # escaped on the wire…
+    assert '\\n' in text and '\\"' in text and "\\\\" in text
+    for line in text.splitlines():
+        assert "\n" not in line  # no raw newlines inside any sample
+    # …and recoverable by the parser
+    parsed = parse_prometheus_text(text)
+    (labels, value), = parsed["hbbft_node_esc_total"]
+    assert labels["who"] == tricky and value == 2
+    assert escape_help("a\nb\\") == "a\\nb\\\\"
+    assert escape_label_value('x"y') == 'x\\"y'
+    # a backslash followed by 'n' must survive the round trip (the
+    # sequential-replace unescape bug: '\\' + 'n' is NOT a newline)
+    c.labels(who="C:\\new").inc()
+    parsed2 = parse_prometheus_text(r.render_prometheus())
+    whos = {l["who"] for l, _v in parsed2["hbbft_node_esc_total"]}
+    assert whos == {tricky, "C:\\new"}
+
+
+def test_collect_callbacks_run_before_exposition():
+    r = Registry()
+    g = r.gauge("hbbft_node_depth", "d")
+    state = {"depth": 3}
+    r.register_callback(lambda: g.set(state["depth"]))
+    assert 'hbbft_node_depth 3' in r.render_prometheus()
+    state["depth"] = 9
+    assert 'hbbft_node_depth 9' in r.render_prometheus()
+
+
+def test_fault_counter_preinitializes_every_variant():
+    from hbbft_tpu.fault_log import FaultKind
+
+    r = Registry()
+    c = fault_counter(r)
+    kinds = {labels["kind"] for labels, _ in c.series()}
+    assert kinds == {k.name for k in FaultKind}
+    # all zero until evidence arrives
+    assert c.total() == 0
+    text = r.render_prometheus()
+    assert 'kind="InvalidProof"' in text
+
+
+def test_default_buckets_are_valid():
+    assert list(DEFAULT_BUCKETS) == sorted(set(DEFAULT_BUCKETS))
+
+
+def test_tools_check_metrics_passes():
+    """The tier-1 contract: every registered metric documented in README,
+    convention-clean, and FaultKind fully labeled."""
+    import tools_check_metrics
+
+    assert tools_check_metrics.main() == 0

@@ -1,0 +1,301 @@
+"""Epoch-phase tracing over a full 4-node QHB epoch (object mode).
+
+The acceptance-shaped assertions: every phase the protocol must exercise
+is present exactly once per epoch, spans are strictly ordered along the
+protocol's causal chain, the epoch span covers all of them, and the JSONL
+export round-trips.  Also covers the ``wire_size`` silent-zero fix."""
+
+import json
+import random
+
+import pytest
+
+from hbbft_tpu.obs.metrics import DEFAULT, Registry
+from hbbft_tpu.obs.spans import PHASE_ORDER, SpanTracer, classify, phase_group
+from hbbft_tpu.protocols.dynamic_honey_badger import DynamicHoneyBadger
+from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
+from hbbft_tpu.protocols.queueing_honey_badger import (
+    QhbBatch,
+    QueueingHoneyBadger,
+    TxInput,
+)
+from hbbft_tpu.sim import NetBuilder, NullAdversary
+
+
+@pytest.fixture(scope="module")
+def qhb_traced_run(shared_netinfo):
+    """One 4-node QHB run with TPKE encryption and a SpanTracer per node,
+    driven to quiescence — shared by the span-shape tests."""
+    n = 4
+    infos = shared_netinfo(4, 13)
+    net = NetBuilder(list(range(n))).adversary(NullAdversary()).observe(
+        lambda nid: SpanTracer(Registry(), node=nid)
+    ).using_step(
+        lambda nid: QueueingHoneyBadger(
+            DynamicHoneyBadger(
+                infos[nid], infos[nid].secret_key(),
+                rng=random.Random(100 + nid),
+                encryption_schedule=EncryptionSchedule.always(),
+            ),
+            batch_size=4, rng=random.Random(200 + nid),
+        )
+    )
+    for i in range(8):
+        net.send_input(i % n, TxInput(b"span-tx-%d" % i))
+    net.run_to_quiescence()
+    return net
+
+
+# phases a fault-free encrypted epoch MUST contain (aba_coin only appears
+# when a round survives to the every-third random-coin epoch; aba_term is
+# delivery-order dependent)
+REQUIRED = (
+    "rbc_value", "rbc_echo", "rbc_ready",
+    "aba_bval", "aba_aux", "aba_conf",
+    "decrypt_share", "decrypt_combine", "epoch",
+)
+
+
+def test_every_phase_present_exactly_once_per_epoch(qhb_traced_run):
+    net = qhb_traced_run
+    for nid in net.node_ids():
+        tracer = net.observers[nid]
+        assert tracer.epochs_finalized >= 2
+        epochs = sorted({(s.era, s.epoch) for s in tracer.finished})
+        for era, epoch in epochs:
+            spans = tracer.spans_for(era, epoch)
+            names = [(s.name, s.round) for s in spans]
+            # exactly one span per (phase, round)
+            assert len(names) == len(set(names)), names
+            present = {s.name for s in spans}
+            for phase in REQUIRED:
+                assert phase in present, (nid, era, epoch, present)
+            # and nothing outside the documented phase vocabulary
+            assert present <= set(PHASE_ORDER)
+
+
+def test_spans_strictly_ordered_along_the_causal_chain(qhb_traced_run):
+    net = qhb_traced_run
+    tracer = net.observers[0]
+    for era, epoch in sorted({(s.era, s.epoch) for s in tracer.finished}):
+        spans = {(s.name, s.round): s
+                 for s in tracer.spans_for(era, epoch)}
+
+        def start(name, rnd=None):
+            return spans[(name, rnd)].t_start
+
+        # a Value strictly precedes the Echos it triggers, which strictly
+        # precede the Readys, which precede round-0 BVal voting, …
+        assert start("rbc_value") < start("rbc_echo") < start("rbc_ready")
+        assert start("rbc_ready") < start("aba_bval", 0)
+        assert start("aba_bval", 0) < start("aba_aux", 0)
+        assert start("aba_aux", 0) < start("aba_conf", 0)
+        assert start("aba_conf", 0) < start("decrypt_share")
+        # the combine stretch starts where the last share landed
+        assert spans[("decrypt_share", None)].t_end <= start(
+            "decrypt_combine")
+        # the epoch span covers everything
+        ep = spans[("epoch", None)]
+        for key, s in spans.items():
+            if key[0] == "epoch":
+                continue
+            assert ep.t_start <= s.t_start and s.t_end <= ep.t_end, key
+        # the finished deque is start-ordered within the epoch
+        ordered = tracer.spans_for(era, epoch)
+        assert all(a.t_start <= b.t_start
+                   for a, b in zip(ordered, ordered[1:]))
+
+
+def test_phase_durations_feed_registry_and_export_round_trips(
+        qhb_traced_run):
+    net = qhb_traced_run
+    tracer = net.observers[1]
+    reg = tracer.registry
+    assert reg.get("hbbft_node_epochs_total").value() == (
+        tracer.epochs_finalized
+    )
+    hist = reg.get("hbbft_phase_duration_seconds")
+    counts = {labels["phase"]: child.count
+              for labels, child in hist.series()}
+    for phase in ("rbc_echo", "aba_conf", "decrypt_share"):
+        assert counts[phase] == tracer.epochs_finalized
+    # JSONL export parses back into the span dicts, in order
+    lines = [json.loads(l) for l in
+             tracer.export_jsonl().splitlines()]
+    assert len(lines) == len(tracer.finished)
+    for line, span in zip(lines, tracer.finished):
+        assert line["name"] == span.name
+        assert line["era"] == span.era and line["epoch"] == span.epoch
+        assert line["duration_s"] == pytest.approx(span.duration_s,
+                                                   abs=1e-5)
+    # phase grouping used by bench.py --net and obs.top
+    assert phase_group("rbc_echo") == "rbc"
+    assert phase_group("aba_coin") == "coin"
+    assert phase_group("aba_bval") == "aba"
+    assert phase_group("decrypt_combine") == "decrypt"
+    assert phase_group("dkg_rotation") == "dkg"
+
+
+def test_classify_ignores_control_and_unknown_messages():
+    from hbbft_tpu.protocols.sender_queue import AlgoMessage, EpochStarted
+
+    assert classify(EpochStarted((0, 3))) is None
+    assert classify(b"raw bytes") is None
+    assert classify(AlgoMessage(msg=b"not a protocol message")) is None
+
+
+def test_classify_unwraps_the_full_qhb_wrapper_chain():
+    from hbbft_tpu.protocols.binary_agreement import BValMsg, CoinMsg
+    from hbbft_tpu.protocols.broadcast import EchoHashMsg, ReadyMsg
+    from hbbft_tpu.protocols.dynamic_honey_badger import HbWrap, KeyGenWrap
+    from hbbft_tpu.protocols.honey_badger import SubsetWrap
+    from hbbft_tpu.protocols.sender_queue import AlgoMessage
+    from hbbft_tpu.protocols.subset import AgreementWrap, BroadcastWrap
+
+    msg = AlgoMessage(HbWrap(2, SubsetWrap(5, BroadcastWrap(
+        1, ReadyMsg(b"\0" * 32)))))
+    assert classify(msg) == (2, 5, "rbc_ready", None)
+    msg = HbWrap(0, SubsetWrap(1, AgreementWrap(2, BValMsg(3, True))))
+    assert classify(msg) == (0, 1, "aba_bval", 3)
+    assert classify(SubsetWrap(4, BroadcastWrap(0, EchoHashMsg(b"r")))) \
+        == (0, 4, "rbc_echo", None)
+    assert classify(KeyGenWrap(7, object())) == (7, 0, "dkg_rotation",
+                                                 None)
+    # CoinMsg carries the ABA round in its own epoch field
+    msg = SubsetWrap(0, AgreementWrap(1, CoinMsg(2, object())))
+    assert classify(msg) == (0, 0, "aba_coin", 2)
+
+
+def test_dkg_rotation_span_emitted_on_era_change(shared_netinfo):
+    """Drive an object-mode remove-validator DKG through VirtualNet with
+    tracers attached: the era rotation must produce exactly one
+    ``dkg_rotation`` span per era, covering the signed Part/Ack traffic
+    and ending at the Complete batch."""
+    from hbbft_tpu.protocols.dynamic_honey_badger import (
+        Change, ChangeInput, UserInput,
+    )
+
+    infos = shared_netinfo(4, 31)
+    net = NetBuilder(list(range(4))).observe(
+        lambda nid: SpanTracer(node=nid)
+    ).using_step(
+        lambda nid: DynamicHoneyBadger(
+            infos[nid], infos[nid].secret_key(),
+            rng=random.Random(5000 + nid),
+            encryption_schedule=EncryptionSchedule.never(),
+        )
+    )
+    for nid in net.node_ids():
+        net.send_input(nid, ChangeInput(Change.node_change({
+            k: net.nodes[nid].algorithm.netinfo.public_key(k)
+            for k in (0, 1, 2)
+        })))
+    for round_ in range(8):
+        for nid in net.node_ids():
+            net.send_input(nid, UserInput(b"dkg-%d" % round_))
+        net.run_to_quiescence()
+        if all(net.nodes[nid].algorithm.era == 1
+               for nid in net.node_ids()):
+            break
+    assert all(net.nodes[nid].algorithm.era == 1
+               for nid in net.node_ids())
+    for nid in net.node_ids():
+        tracer = net.observers[nid]
+        dkg = [s for s in tracer.finished if s.name == "dkg_rotation"]
+        assert len(dkg) == 1, (nid, dkg)
+        span = dkg[0]
+        assert span.era == 0 and span.count > 0
+        assert span.t_end > span.t_start
+        # it reached the registry histogram too
+        hist = tracer.registry.get("hbbft_phase_duration_seconds")
+        counts = {labels["phase"]: child.count
+                  for labels, child in hist.series()}
+        assert counts["dkg_rotation"] == 1
+
+
+def test_open_epoch_state_is_bounded_and_finalized_epochs_stay_closed():
+    """A Byzantine peer minting arbitrary (era, epoch) keys must not grow
+    tracer state without bound, and a straggler message for an already-
+    finalized epoch must not re-open it (it could never finalize again)."""
+    from hbbft_tpu.protocols.broadcast import ReadyMsg
+    from hbbft_tpu.protocols.honey_badger import Batch, SubsetWrap
+    from hbbft_tpu.protocols.subset import BroadcastWrap
+    from hbbft_tpu.traits import Step
+
+    tracer = SpanTracer(node=0, max_open_epochs=16)
+    for epoch in range(500):
+        tracer.on_message(1, SubsetWrap(epoch, BroadcastWrap(
+            0, ReadyMsg(b"\0" * 32))))
+    assert len(tracer._open) <= 16
+    evicted = tracer.registry.get(
+        "hbbft_phase_open_epochs_evicted_total")
+    assert evicted.value() == 500 - 16
+    # the genuine in-progress trace (the LOWEST open key) survives a
+    # flood of attacker-minted future keys: epoch 0's aggregation is
+    # still there with its message counted
+    assert (0, 0) in tracer._open
+    assert tracer._open[(0, 0)][("rbc_ready", None)].count == 1
+    # same bound for per-era DKG state
+    from hbbft_tpu.protocols.dynamic_honey_badger import KeyGenWrap
+
+    for era in range(100):
+        tracer.on_message(1, KeyGenWrap(era, object()))
+    assert len(tracer._dkg_open) <= 8
+    assert 0 in tracer._dkg_open  # lowest (genuine) era kept
+    # finalize epoch 499, then a straggler for it arrives late
+    tracer.on_step(Step(output=[Batch(epoch=499, contributions=())]))
+    assert (0, 499) not in tracer._open
+    tracer.on_message(1, SubsetWrap(499, BroadcastWrap(
+        0, ReadyMsg(b"\0" * 32))))
+    assert (0, 499) not in tracer._open  # not re-opened
+    assert tracer.epochs_finalized == 1
+
+
+def test_reconnects_view_survives_label_cardinality_overflow():
+    """Past the metric's label cap, overflowed peers share one series —
+    the dict view must still report exact per-peer counts and only apply
+    deltas to the shared series (no clobbering)."""
+    from hbbft_tpu.net.transport import _LabeledCounterView
+    from hbbft_tpu.obs.metrics import OVERFLOW
+
+    reg = Registry()
+    counter = reg.counter("hbbft_net_reconnects_total", "r",
+                          labelnames=("peer",), max_label_sets=2)
+    view = _LabeledCounterView(counter)
+    for peer in range(5):
+        for _ in range(peer + 1):
+            view[peer] = view.get(peer, 0) + 1
+    # dict semantics exact for every peer, capped or not
+    assert dict(view.items()) == {p: p + 1 for p in range(5)}
+    assert view[4] == 5 and 4 in view and len(view) == 5
+    series = {labels["peer"]: child.get()
+              for labels, child in counter.series()}
+    # the two real series are exact; the overflow series aggregates the
+    # rest instead of holding only the last write
+    assert series["0"] == 1 and series["1"] == 2
+    assert series[OVERFLOW] == 3 + 4 + 5
+
+
+def test_wire_size_failure_is_counted_and_logged_once(caplog):
+    import logging
+
+    from hbbft_tpu.sim.trace import wire_size
+
+    class Unencodable:
+        pass
+
+    counter = DEFAULT.counter(
+        "hbbft_sim_wire_size_failures_total", "", labelnames=("type",))
+    before = counter.value(type="Unencodable")
+    with caplog.at_level(logging.WARNING, logger="hbbft_tpu.sim"):
+        assert wire_size(Unencodable()) == 0
+        assert wire_size(Unencodable()) == 0
+    after = counter.value(type="Unencodable")
+    assert after == before + 2
+    warnings = [r for r in caplog.records
+                if "wire_size" in r.getMessage()]
+    assert len(warnings) <= 1  # logged at most once per type path
+    # a real protocol message still encodes with a positive size
+    from hbbft_tpu.protocols.broadcast import ReadyMsg
+
+    assert wire_size(ReadyMsg(b"\0" * 32)) > 0
