@@ -15,6 +15,7 @@ the one that matters.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import hashlib
 import json
 import struct
@@ -225,10 +226,10 @@ class ClusterClient:
         for task in (self._reader_task, self._keepalive_task):
             if task is not None:
                 task.cancel()
-                try:
+                # suppress: awaiting our own cancelled tasks; a late recv
+                # error already failed all waiters via _fail_waiters
+                with contextlib.suppress(asyncio.CancelledError, Exception):
                     await task
-                except (asyncio.CancelledError, Exception):
-                    pass
         if self._writer is not None:
             self._writer.close()
 
@@ -322,8 +323,11 @@ class ClusterClient:
                         self.max_frame,
                     ))
                     await self._writer.drain()
+            # hblint: disable=fault-swallowed-drop (nothing to account
+            # client-side: the recv loop fails every pending waiter with
+            # the connection error; this loop just stops pinging)
             except (ConnectionError, OSError):
-                return  # the recv loop surfaces the death to waiters
+                return
 
     async def _recv_loop(self) -> None:
         decoder = FrameDecoder(self.max_frame)

@@ -220,6 +220,8 @@ def find_free_base_port(n: int, lo: int = 23000, hi: int = 52000) -> int:
                 s.bind(("127.0.0.1", base + i))
                 socks.append(s)
             return base
+        # hblint: disable=fault-swallowed-drop (port-availability probe:
+        # a busy port is the expected negative result, not dropped input)
         except OSError:
             continue
         finally:
@@ -286,6 +288,8 @@ def shutdown_procs(procs, timeout_s: float = 15.0) -> None:
     for p in procs:
         try:
             p.wait(timeout=timeout_s)
+        # hblint: disable=fault-swallowed-drop (escalation, not a drop:
+        # a node ignoring SIGTERM for timeout_s is SIGKILLed)
         except subprocess.TimeoutExpired:
             p.kill()
 

@@ -46,6 +46,7 @@ re-entrantly (it only enqueues).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import hashlib
 import logging
 import random
@@ -182,6 +183,16 @@ class TransportStats:
         self._dead_peer_events = r.counter(
             "hbbft_net_dead_peer_events_total",
             "peers declared dead after missed heartbeats")
+        # drop accounting (hblint fault-swallowed-drop): connection-level
+        # losses must be scrapeable, not just debug-logged
+        self._inbound_drops = r.counter(
+            "hbbft_net_inbound_drops_total",
+            "inbound connections dropped on error/timeout before or "
+            "during frame processing")
+        self._client_conn_drops = r.counter(
+            "hbbft_net_client_conn_drops_total",
+            "client connections dropped mid-send (write-buffer overflow "
+            "or dead socket)")
         # virtual cost of received traffic under the attached CostModel —
         # the simulator's synthetic clock applied to real frames, so sim
         # and net runs report comparable virtual time
@@ -203,6 +214,8 @@ class TransportStats:
     bytes_recv = MetricAttr("_bytes_recv")
     send_queue_peak = MetricAttr("_send_queue_peak")
     dead_peer_events = MetricAttr("_dead_peer_events")
+    inbound_drops = MetricAttr("_inbound_drops")
+    client_conn_drops = MetricAttr("_client_conn_drops")
     virtual_cost_s = MetricAttr("_virtual_cost", cast=float)
 
     def record_backoff(self, peer_id: NodeId, delay: float) -> None:
@@ -218,6 +231,8 @@ class TransportStats:
             "reconnects": {repr(k): v for k, v in self.reconnects.items()},
             "send_queue_peak": self.send_queue_peak,
             "dead_peer_events": self.dead_peer_events,
+            "inbound_drops": self.inbound_drops,
+            "client_conn_drops": self.client_conn_drops,
             "virtual_cost_s": round(self.virtual_cost_s, 6),
         }
 
@@ -236,7 +251,8 @@ class ClientConn:
     _next = 0
 
     def __init__(self, hello: Hello, writer: asyncio.StreamWriter,
-                 max_frame: int, record_send=None):
+                 max_frame: int, record_send=None,
+                 stats: Optional["TransportStats"] = None):
         ClientConn._next += 1
         self.conn_id = ClientConn._next
         self.hello = hello
@@ -244,7 +260,13 @@ class ClientConn:
         self._writer = writer
         self._max_frame = max_frame
         self._record_send = record_send
+        self._stats = stats
         self.closed = False
+
+    def _drop(self) -> None:
+        self.closed = True
+        if self._stats is not None:
+            self._stats.client_conn_drops += 1
 
     def send(self, kind: int, payload: bytes) -> None:
         if self.closed:
@@ -252,7 +274,7 @@ class ClientConn:
         try:
             if (self._writer.transport.get_write_buffer_size()
                     > self.MAX_WRITE_BUFFER):
-                self.closed = True
+                self._drop()
                 self._writer.close()
                 return
             frame = framing.encode_frame(kind, payload, self._max_frame)
@@ -260,7 +282,7 @@ class ClientConn:
             if self._record_send is not None:
                 self._record_send(self.client_id, frame)
         except (ConnectionError, RuntimeError):
-            self.closed = True
+            self._drop()
 
 
 class _PeerSender:
@@ -430,8 +452,11 @@ class _PeerSender:
                 ping_nonce += 1
                 try:
                     await asyncio.wait_for(ping_once(), self.t.heartbeat_s)
+                # hblint: disable=fault-swallowed-drop (nothing is
+                # dropped: a congested writer just skips this ping and
+                # the pong deadline above decides peer death)
                 except asyncio.TimeoutError:
-                    pass  # writer congested; the pong deadline decides
+                    pass
 
         self.wake.set()  # flush anything queued while disconnected
         tasks = [
@@ -456,10 +481,10 @@ class _PeerSender:
         self.stopped = True
         if self.task is not None:
             self.task.cancel()
-            try:
+            # suppress: awaiting our own cancelled task; any late error
+            # was already logged by _serve and the sender is going away
+            with contextlib.suppress(asyncio.CancelledError, Exception):
                 await self.task
-            except (asyncio.CancelledError, Exception):
-                pass
 
 
 class Transport:
@@ -578,6 +603,9 @@ class Transport:
             OSError, FrameError, ValueError,
             asyncio.IncompleteReadError, asyncio.TimeoutError,
         ) as exc:
+            # an inbound peer/client dying here silently disappeared from
+            # the metrics before (hblint fault-swallowed-drop): count it
+            self.stats.inbound_drops += 1
             logger.debug("inbound connection dropped: %r", exc)
         finally:
             self._inbound_tasks.discard(task)
@@ -645,7 +673,7 @@ class Transport:
                                 reader: asyncio.StreamReader,
                                 writer: asyncio.StreamWriter) -> None:
         conn = ClientConn(hello, writer, self.max_frame,
-                          record_send=self._record_send)
+                          record_send=self._record_send, stats=self.stats)
         decoder = FrameDecoder(self.max_frame)
         try:
             while True:

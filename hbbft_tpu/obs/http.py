@@ -17,6 +17,7 @@ localhost or a private fabric, like the consensus port.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import logging
 from typing import Callable, Dict, Optional, Tuple
@@ -102,10 +103,10 @@ class ObsServer:
                 asyncio.LimitOverrunError, ValueError, OSError) as exc:
             logger.debug("obs request dropped: %r", exc)
         finally:
-            try:
+            # suppress: best-effort close of a possibly-dead diagnostics
+            # socket; the request itself was already served or logged
+            with contextlib.suppress(Exception):
                 writer.close()
-            except Exception:
-                pass
 
 
 def http_get(host: str, port: int, path: str,

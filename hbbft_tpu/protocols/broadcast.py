@@ -316,8 +316,12 @@ class Broadcast(ConsensusProtocol):
         if self.decided or self.fault:
             return Step()
         n, f = self.netinfo.num_nodes(), self.netinfo.num_faulty()
+        # sorted: with Byzantine equivocation two roots can in principle
+        # clear both thresholds in the same crank at small n — set
+        # iteration order must not pick which one decodes (hblint
+        # det-set-iteration)
         roots = {r for r in self.readys.values()}
-        for root in roots:
+        for root in sorted(roots):
             if self._count_readys(root) < 2 * f + 1:
                 continue
             if self._count_echos(root) < self.data_shard_num:

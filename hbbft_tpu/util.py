@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import contextlib
 import random
 from typing import Optional
 
@@ -40,12 +41,11 @@ def enable_compilation_cache(path: Optional[str] = None) -> None:
     if path is None:  # anchor to the repo, not the launch cwd
         path = os.path.join(os.path.dirname(os.path.dirname(__file__)),
                             ".jax_cache")
-    try:
+    # suppress: older jax / unsupported backend is non-fatal by contract
+    with contextlib.suppress(Exception):  # pragma: no cover
         jax.config.update("jax_compilation_cache_dir", path)
         # 1 s threshold: the suite re-pays hundreds of 1–5 s compiles per
         # process otherwise; the cache entries are small relative to the
         # ladder executables that dominate the directory
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception:  # pragma: no cover - older jax / unsupported backend
-        pass

@@ -1,0 +1,17 @@
+"""hblint fixture: every asyncio-hazard rule fires on this snippet."""
+
+import asyncio
+import time
+
+
+async def worker():
+    await asyncio.sleep(0)
+
+
+async def pump(lock, writer):
+    worker()                        # async-unawaited-coroutine
+    asyncio.create_task(worker())   # async-fire-and-forget-task
+    time.sleep(0.1)                 # async-blocking-call
+    async with lock:
+        writer.write(b"x")
+        await writer.drain()        # async-lock-across-await

@@ -1,0 +1,17 @@
+"""hblint fixture: the corrected async_bad — zero asyncio findings."""
+
+import asyncio
+
+
+async def worker():
+    await asyncio.sleep(0)
+
+
+async def pump(lock, writer):
+    await worker()
+    task = asyncio.create_task(worker())
+    await asyncio.sleep(0.1)
+    async with lock:
+        writer.write(b"x")          # write() does not await
+    await writer.drain()            # drain outside the lock
+    await task

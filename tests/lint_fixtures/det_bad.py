@@ -1,0 +1,29 @@
+"""hblint fixture: every determinism rule fires on this snippet."""
+
+import os
+import random
+import time
+
+
+def encode_message(x):
+    return bytes([x % 256])
+
+
+def elect(epoch):
+    now = time.time()           # det-wall-clock
+    coin = random.random()      # det-unseeded-random
+    salt = os.urandom(8)        # det-unseeded-random
+    return now, coin, salt
+
+
+def fan_out(peers):
+    ids = {p for p in peers}
+    out = b""
+    for p in ids:               # det-set-iteration (loop feeds encoder)
+        out += encode_message(p)
+    return out
+
+
+def digest_votes(votes):
+    seen = set(votes)
+    return b"".join(encode_message(v) for v in seen)  # det-set-iteration

@@ -1,0 +1,32 @@
+"""hblint fixture: the corrected det_bad — zero determinism findings."""
+
+import os
+import random
+
+
+def encode_message(x):
+    return bytes([x % 256])
+
+
+def elect(epoch, rng):
+    # seeded instance randomness is the sanctioned source
+    coin = rng.random()
+    return epoch, coin
+
+
+def generate_keypair():
+    # key-generation entry point: OS entropy is allowed here
+    return os.urandom(32), random.Random(0)
+
+
+def fan_out(peers):
+    ids = {p for p in peers}
+    out = b""
+    for p in sorted(ids):       # deterministic order
+        out += encode_message(p)
+    return out
+
+
+def digest_votes(votes):
+    seen = set(votes)
+    return b"".join(encode_message(v) for v in sorted(seen))

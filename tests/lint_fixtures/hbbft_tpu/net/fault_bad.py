@@ -1,0 +1,19 @@
+"""hblint fixture: both fault-accounting rules fire on this snippet."""
+
+
+def handle(data):
+    return data
+
+
+def recv_frame(sock):
+    try:
+        return sock.read()
+    except Exception:               # fault-except-pass
+        pass
+
+
+def process(peer, data):
+    try:
+        handle(data)
+    except ValueError:              # fault-swallowed-drop
+        return None

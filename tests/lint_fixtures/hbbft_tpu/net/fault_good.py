@@ -1,0 +1,21 @@
+"""hblint fixture: the corrected fault_bad — zero fault findings."""
+
+import contextlib
+
+
+def handle(data):
+    return data
+
+
+def recv_frame(sock):
+    with contextlib.suppress(ConnectionError):
+        return sock.read()
+    return None
+
+
+def process(peer, data, stats):
+    try:
+        handle(data)
+    except ValueError:
+        stats.decode_failures += 1  # accounted drop
+        return None

@@ -1,0 +1,2 @@
+def setup(r):
+    return r.counter("hbbft_bogus_thing_total", "bad layer, undocumented")

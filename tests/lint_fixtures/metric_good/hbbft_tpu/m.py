@@ -1,0 +1,2 @@
+def setup(r):
+    return r.counter("hbbft_node_things_total", "convention-clean")

@@ -1,0 +1,8 @@
+"""hblint fixture: a message-shaped dataclass with no wire registration."""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class OrphanMsg:                    # wire-unregistered (and mutable)
+    x: int

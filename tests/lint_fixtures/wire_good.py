@@ -1,0 +1,8 @@
+"""hblint fixture: a frozen, (synthetically) registered message class."""
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class PlainMsg:                     # registered via the test's injection
+    x: int

@@ -1,0 +1,335 @@
+"""hblint: the static-analysis suite itself, plus the tier-1 repo gate.
+
+Four layers:
+
+- fixture tests — every checker fires on a minimal violating snippet
+  (``tests/lint_fixtures/*_bad.py``) and stays quiet on the corrected
+  version (``*_good.py``);
+- framework semantics — suppression comments (line / file / comment-line
+  above), baseline fingerprints (content-anchored: stable under line
+  drift, invalidated by editing the anchored line), JSON reporter schema;
+- registry invariants — the wire-completeness runtime cross-check over a
+  synthetic registry;
+- the tier-1 gate — ``python -m hbbft_tpu.lint --json`` over the repo via
+  the MODULE ENTRY POINT (so the CLI path stays exercised) must be clean
+  with ≤ 10 baselined findings.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from dataclasses import dataclass
+
+from hbbft_tpu.lint.core import (
+    ModuleSource,
+    render_baseline,
+    run_lint,
+)
+from hbbft_tpu.lint.asyncio_hazard import AsyncioHazardChecker
+from hbbft_tpu.lint.determinism import DeterminismChecker
+from hbbft_tpu.lint.fault_accounting import FaultAccountingChecker
+from hbbft_tpu.lint.metric_convention import check_metrics
+from hbbft_tpu.lint.reporters import render_json
+from hbbft_tpu.lint.wire_completeness import WireCompletenessChecker
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fired(checker, fixture_name):
+    mod = ModuleSource(FIXTURES, fixture_name)
+    assert mod.parse_error is None
+    return [f.rule for f in checker.check_module(mod)]
+
+
+# ---------------------------------------------------------------------------
+# per-checker fixtures: bad fires, good is quiet
+
+
+def test_determinism_fixture():
+    rules = fired(DeterminismChecker(), "det_bad.py")
+    assert "det-wall-clock" in rules
+    assert rules.count("det-unseeded-random") == 2  # random.random + urandom
+    assert rules.count("det-set-iteration") == 2    # loop + genexp sink
+    assert fired(DeterminismChecker(), "det_good.py") == []
+
+
+def test_asyncio_fixture():
+    rules = fired(AsyncioHazardChecker(), "async_bad.py")
+    assert set(rules) == {
+        "async-unawaited-coroutine", "async-fire-and-forget-task",
+        "async-blocking-call", "async-lock-across-await",
+    }
+    assert fired(AsyncioHazardChecker(), "async_good.py") == []
+
+
+def test_fault_accounting_fixture():
+    # the drop rule self-scopes to hbbft_tpu/net/ paths, so the fault
+    # fixtures live under that relative path inside the fixture root
+    rules = fired(FaultAccountingChecker(), "hbbft_tpu/net/fault_bad.py")
+    assert set(rules) == {"fault-except-pass", "fault-swallowed-drop"}
+    assert fired(FaultAccountingChecker(),
+                 "hbbft_tpu/net/fault_good.py") == []
+
+
+def test_wire_ast_fixture():
+    chk = WireCompletenessChecker()
+    bad = ModuleSource(FIXTURES, "wire_bad.py")
+    rules = [f.rule for f in chk.ast_unregistered(bad, registered=set())]
+    assert rules == ["wire-unregistered"]
+    good = ModuleSource(FIXTURES, "wire_good.py")
+    assert chk.ast_unregistered(good, registered={"PlainMsg"}) == []
+
+
+def test_metric_convention_fixture():
+    bad_root = os.path.join(FIXTURES, "metric_bad")
+    problems, n, _ = check_metrics(bad_root, check_faults=False)
+    msgs = [m for m, _p, _l in problems]
+    assert n == 1
+    assert any("violates the naming convention" in m for m in msgs)
+    assert any("not documented" in m for m in msgs)
+    good_root = os.path.join(FIXTURES, "metric_good")
+    problems, n, _ = check_metrics(good_root, check_faults=False)
+    assert problems == [] and n == 1
+
+
+# ---------------------------------------------------------------------------
+# wire registry invariants over a synthetic registry
+
+
+def test_wire_registry_invariants():
+    @dataclass(frozen=True)
+    class GoodM:
+        x: int
+
+    @dataclass
+    class MutableM:
+        x: int
+
+    class UnhashableM:
+        __hash__ = None
+
+    chk = WireCompletenessChecker()
+    tags = {
+        GoodM: (0x01, None),
+        MutableM: (0x01, None),      # duplicate tag + not frozen
+        UnhashableM: (0x02, None),   # decoder missing + unhashable
+    }
+    decoders = {0x01: None, 0x07: None}  # 0x07: decoder without encoder
+    out = chk.registry_findings(
+        tags, decoders, locate=lambda cls: ("x.py", 1, ""))
+    rules = sorted(f.rule for f in out)
+    assert rules.count("wire-duplicate-tag") == 1
+    assert rules.count("wire-missing-codec") == 2  # 0x02 enc-only, 0x07 dec-only
+    # MutableM too: dataclass(eq=True, frozen=False) sets __hash__ = None
+    assert rules.count("wire-not-hashable") == 2
+    # MutableM and UnhashableM (not a dataclass at all) both lack frozen
+    assert rules.count("wire-not-frozen") == 2
+    # an all-good registry is silent
+    assert chk.registry_findings(
+        {GoodM: (0x01, None)}, {0x01: None},
+        locate=lambda cls: ("x.py", 1, "")) == []
+
+
+def test_wire_registry_real_repo_is_clean():
+    """The live registry: unique tags, codec pairs, frozen+hashable."""
+    from hbbft_tpu.protocols import wire
+
+    wire.ensure_registered()
+    chk = WireCompletenessChecker()
+    out = chk.registry_findings(
+        dict(wire._MSG_TAGS), dict(wire._MSG_DECODERS),
+        locate=lambda cls: ("x.py", 0, ""))
+    assert out == [], [f.message for f in out]
+
+
+# ---------------------------------------------------------------------------
+# framework semantics on a synthetic repo tree
+
+
+def _write(tmp_path, rel, text):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(text)
+    return path
+
+
+_VIOLATION = "import time\n\ndef f():\n    return time.time()\n"
+
+
+def _lint_tmp(tmp_path, **kwargs):
+    kwargs.setdefault("checkers", [DeterminismChecker()])
+    kwargs.setdefault("baseline_path", None)
+    return run_lint(root=str(tmp_path), paths=["hbbft_tpu"], **kwargs)
+
+
+def test_scope_and_basic_finding(tmp_path):
+    _write(tmp_path, "hbbft_tpu/protocols/x.py", _VIOLATION)
+    # same violation outside the determinism scope: not flagged
+    _write(tmp_path, "hbbft_tpu/net/y.py", _VIOLATION)
+    result = _lint_tmp(tmp_path)
+    assert [f.path for f in result.findings] == ["hbbft_tpu/protocols/x.py"]
+    assert result.findings[0].rule == "det-wall-clock"
+    assert result.findings[0].line == 4
+
+
+def test_suppression_same_line(tmp_path):
+    _write(tmp_path, "hbbft_tpu/protocols/x.py",
+           "import time\n\ndef f():\n"
+           "    return time.time()  # hblint: disable=det-wall-clock (why)\n")
+    result = _lint_tmp(tmp_path)
+    assert result.findings == [] and result.suppressed == 1
+
+
+def test_suppression_comment_line_above(tmp_path):
+    _write(tmp_path, "hbbft_tpu/protocols/x.py",
+           "import time\n\ndef f():\n"
+           "    # hblint: disable=det-wall-clock (justification)\n"
+           "    return time.time()\n")
+    result = _lint_tmp(tmp_path)
+    assert result.findings == [] and result.suppressed == 1
+
+
+def test_suppression_file_level_and_all(tmp_path):
+    _write(tmp_path, "hbbft_tpu/protocols/x.py",
+           "# hblint: disable-file=det-wall-clock\n" + _VIOLATION)
+    assert _lint_tmp(tmp_path).findings == []
+    _write(tmp_path, "hbbft_tpu/protocols/x.py",
+           "# hblint: disable-file=all\n" + _VIOLATION)
+    assert _lint_tmp(tmp_path).findings == []
+
+
+def test_suppression_justification_words_are_not_rules(tmp_path):
+    """An unparenthesized justification after the rule list must not leak
+    tokens (like the word 'all') into the suppression set."""
+    _write(tmp_path, "hbbft_tpu/protocols/x.py",
+           "import time\n\ndef f():\n"
+           "    return time.time()  "
+           "# hblint: disable=det-set-iteration all timers are benign\n")
+    result = _lint_tmp(tmp_path)
+    assert [f.rule for f in result.findings] == ["det-wall-clock"]
+
+
+def test_suppression_wrong_rule_does_not_apply(tmp_path):
+    _write(tmp_path, "hbbft_tpu/protocols/x.py",
+           "import time\n\ndef f():\n"
+           "    return time.time()  # hblint: disable=det-set-iteration\n")
+    result = _lint_tmp(tmp_path)
+    assert [f.rule for f in result.findings] == ["det-wall-clock"]
+
+
+def test_baseline_grandfathers_and_survives_line_drift(tmp_path):
+    _write(tmp_path, "hbbft_tpu/protocols/x.py", _VIOLATION)
+    first = _lint_tmp(tmp_path)
+    assert len(first.findings) == 1
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text(render_baseline(first.findings))
+    result = _lint_tmp(tmp_path, baseline_path=str(baseline))
+    assert result.findings == []
+    assert [f.rule for f in result.baselined] == ["det-wall-clock"]
+    assert result.stale_baseline == 0
+    # unrelated edits shift the line: the content fingerprint still holds
+    _write(tmp_path, "hbbft_tpu/protocols/x.py",
+           "# a new leading comment\n# another\n" + _VIOLATION)
+    result = _lint_tmp(tmp_path, baseline_path=str(baseline))
+    assert result.findings == [] and len(result.baselined) == 1
+    # editing the anchored line itself invalidates the entry, on purpose
+    _write(tmp_path, "hbbft_tpu/protocols/x.py",
+           _VIOLATION.replace("return time.time()",
+                              "return 1 + time.time()"))
+    result = _lint_tmp(tmp_path, baseline_path=str(baseline))
+    assert len(result.findings) == 1 and result.stale_baseline == 1
+
+
+def test_changed_only_includes_untracked_files(tmp_path):
+    """--changed-only is the pre-commit path: a brand-new (untracked)
+    violating module must still be scanned."""
+    git = lambda *a: subprocess.run(  # noqa: E731
+        ["git", *a], cwd=tmp_path, capture_output=True, text=True,
+        check=True,
+        env=dict(os.environ, GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+                 GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t"),
+    )
+    git("init", "-q")
+    _write(tmp_path, "hbbft_tpu/protocols/clean.py", "X = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    _write(tmp_path, "hbbft_tpu/protocols/fresh.py", _VIOLATION)  # untracked
+    result = _lint_tmp(tmp_path, changed_only="HEAD")
+    assert [f.path for f in result.findings] == [
+        "hbbft_tpu/protocols/fresh.py"]
+
+
+def test_write_baseline_refuses_restricted_scan():
+    proc = _run_cli("hbbft_tpu/obs", "--write-baseline")
+    assert proc.returncode == 2
+    assert "full scan" in proc.stderr
+    proc = _run_cli("--changed-only", "HEAD", "--write-baseline")
+    assert proc.returncode == 2
+
+
+def test_syntax_error_is_reported_not_fatal(tmp_path):
+    _write(tmp_path, "hbbft_tpu/protocols/x.py", "def broken(:\n")
+    result = _lint_tmp(tmp_path)
+    assert [f.rule for f in result.findings] == ["syntax-error"]
+
+
+def test_json_reporter_schema(tmp_path):
+    _write(tmp_path, "hbbft_tpu/protocols/x.py", _VIOLATION)
+    doc = json.loads(render_json(_lint_tmp(tmp_path)))
+    assert doc["version"] == 1 and doc["tool"] == "hblint"
+    assert set(doc) >= {"checkers", "findings", "baselined", "summary"}
+    (f,) = doc["findings"]
+    assert set(f) == {"checker", "rule", "path", "line", "message",
+                      "fingerprint"}
+    assert f["rule"] == "det-wall-clock"
+    s = doc["summary"]
+    assert set(s) >= {"findings", "baselined", "suppressed",
+                      "files_scanned", "stale_baseline", "clean"}
+    assert s["findings"] == 1 and s["clean"] is False
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 repo gate, via the module entry point
+
+
+def _run_cli(*args, timeout=240):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "hbbft_tpu.lint", *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_lint_repo_clean():
+    """Zero non-baselined findings over the repo, ≤ 10 grandfathered."""
+    proc = _run_cli("--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["findings"] == [], doc["findings"]
+    assert doc["summary"]["clean"] is True
+    assert doc["summary"]["baselined"] <= 10
+    # all five checkers ran
+    assert set(doc["checkers"]) == {
+        "determinism", "asyncio-hazard", "wire-completeness",
+        "fault-accounting", "metric-convention",
+    }
+
+
+def test_lint_cli_list_rules():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rule in ("det-wall-clock", "async-fire-and-forget-task",
+                 "wire-not-hashable", "fault-except-pass",
+                 "metric-convention"):
+        assert rule in proc.stdout
+
+
+def test_lint_cli_changed_only():
+    """--changed-only HEAD: the fast pre-commit path stays wired."""
+    proc = _run_cli("--json", "--changed-only", "HEAD")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["findings"] == []

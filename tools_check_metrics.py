@@ -1,6 +1,11 @@
 #!/usr/bin/env python
 """Static metrics-contract check (tier 1 via tests/test_obs_metrics.py).
 
+Thin CLI shim over :mod:`hbbft_tpu.lint.metric_convention` (the checker is
+part of the hblint suite — ``python -m hbbft_tpu.lint`` runs it together
+with the other checkers).  Kept byte-compatible with the original tool:
+same exit codes, same violation messages, same OK line.
+
 Asserts three things about the observability surface so it cannot rot
 silently:
 
@@ -20,87 +25,27 @@ Exit status 0 iff all checks pass; findings go to stdout.
 from __future__ import annotations
 
 import os
-import re
 import sys
 
+from hbbft_tpu.lint.metric_convention import check_metrics, scan_registrations
+
 REPO = os.path.dirname(os.path.abspath(__file__))
-
-NAME_CONVENTION = re.compile(r"^hbbft_(net|node|phase|sim)_[a-z][a-z0-9_]*$")
-
-# a registration is a .counter( / .gauge( / .histogram( call whose first
-# argument is a string literal starting with hbbft_ (possibly on the next
-# line); DEFAULT.counter(...) in sim/trace.py matches the same shape
-_REG_RE = re.compile(
-    r"\.(?:counter|gauge|histogram)\(\s*[\r\n]?\s*['\"](hbbft_[A-Za-z0-9_]*)['\"]",
-    re.MULTILINE,
-)
 
 
 def registered_metric_names():
     """(name, file) pairs for every registration in the package + bench."""
-    roots = []
-    pkg = os.path.join(REPO, "hbbft_tpu")
-    for dirpath, _dirs, files in os.walk(pkg):
-        for fn in files:
-            if fn.endswith(".py"):
-                roots.append(os.path.join(dirpath, fn))
-    roots.append(os.path.join(REPO, "bench.py"))
-    out = []
-    for path in roots:
-        with open(path, encoding="utf-8") as fh:
-            src = fh.read()
-        for m in _REG_RE.finditer(src):
-            out.append((m.group(1), os.path.relpath(path, REPO)))
-    return out
+    return [(name, path) for name, path, _line in scan_registrations(REPO)]
 
 
 def main() -> int:
-    problems = []
-    regs = registered_metric_names()
-    if not regs:
-        problems.append("no metric registrations found at all — the "
-                        "scanner regex is broken")
-    with open(os.path.join(REPO, "README.md"), encoding="utf-8") as fh:
-        readme = fh.read()
-
-    seen = {}
-    for name, path in regs:
-        seen.setdefault(name, set()).add(path)
-    for name in sorted(seen):
-        where = ", ".join(sorted(seen[name]))
-        if not NAME_CONVENTION.match(name):
-            problems.append(
-                f"{name} ({where}): violates the naming convention "
-                f"hbbft_<net|node|phase|sim>_<name>"
-            )
-        if f"`{name}`" not in readme and name not in readme:
-            problems.append(
-                f"{name} ({where}): not documented in README.md's "
-                f"Observability section"
-            )
-
-    # FaultKind coverage: the runtime pre-initializes one label per
-    # variant via obs.metrics.fault_counter — verify against the enum
-    from hbbft_tpu.fault_log import FaultKind
-    from hbbft_tpu.obs.metrics import Registry, fault_counter
-
-    reg = Registry()
-    c = fault_counter(reg)
-    labeled = {labels["kind"] for labels, _child in c.series()}
-    for k in FaultKind:
-        if k.name not in labeled:
-            problems.append(
-                f"FaultKind.{k.name}: no pre-initialized label on "
-                f"hbbft_node_faults_total (obs.metrics.fault_counter)"
-            )
-
+    problems, n_names, n_labels = check_metrics(REPO)
     if problems:
         print("tools_check_metrics: FAIL")
-        for p in problems:
-            print(f"  - {p}")
+        for message, _path, _line in problems:
+            print(f"  - {message}")
         return 1
-    print(f"tools_check_metrics: OK — {len(seen)} metric names, "
-          f"{len(labeled)} fault-kind labels, all documented and "
+    print(f"tools_check_metrics: OK — {n_names} metric names, "
+          f"{n_labels} fault-kind labels, all documented and "
           f"convention-clean")
     return 0
 
