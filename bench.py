@@ -1277,6 +1277,109 @@ def net_cluster_bench(epochs_target: int = 20, n: int = 4,
     print(json.dumps(line), flush=True)
 
 
+# ===========================================================================
+# --compare: regression gate over two recorded bench JSON lines
+# ===========================================================================
+
+
+def load_bench_json(path):
+    """The LAST JSON object in a bench output file (`BENCH_*.json` files
+    hold exactly one; piped logs may prefix `#` detail lines)."""
+    last = None
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        return json.loads(text)
+    except ValueError:
+        pass
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                last = json.loads(line)
+            except ValueError:
+                continue  # truncated/garbled line: salvage the rest
+    if last is None:
+        raise ValueError(f"{path}: no JSON object found")
+    return last
+
+
+def _lookup(doc, dotted):
+    cur = doc
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def compare_bench(old, new, threshold: float = 0.15,
+                  phase_threshold=None):
+    """Regression verdict between two bench JSON lines.
+
+    Headline throughput (``value``, higher-better when the unit is a
+    rate), client latency p50/p99 and the epoch wall (lower-better) gate
+    at ``threshold`` relative change; per-phase attribution deltas
+    (rbc/aba/coin/decrypt ``attr_p50_ms``) gate at ``phase_threshold``
+    (default 2×threshold — attribution is noisier than the headline, but
+    a phase silently doubling is exactly the drift this gate exists to
+    catch).  Returns a report dict with ``ok`` False on any regression.
+    """
+    if phase_threshold is None:
+        phase_threshold = 2 * threshold
+    checks = []
+
+    def add(name, higher_better, limit):
+        o, n = _lookup(old, name), _lookup(new, name)
+        if o is None or n is None or o <= 0:
+            return  # not comparable (absent / null phase) — skip
+        delta = (n - o) / o
+        worse = -delta if higher_better else delta
+        checks.append({
+            "name": name,
+            "old": o,
+            "new": n,
+            "delta_pct": round(100 * delta, 2),
+            "threshold_pct": round(100 * limit, 2),
+            "regressed": worse > limit,
+        })
+
+    unit = str(old.get("unit", ""))
+    add("value", unit.endswith("/s"), threshold)
+    for lat in ("p50_latency_ms", "p99_latency_ms"):
+        add(lat, False, threshold)
+    add("phases.epoch_wall_p50_ms", False, threshold)
+    add("phases.epoch_wall_p99_ms", False, threshold)
+    for group in ("rbc", "aba", "coin", "decrypt"):
+        add(f"phases.{group}.attr_p50_ms", False, phase_threshold)
+    regressions = [c["name"] for c in checks if c["regressed"]]
+    return {
+        "metric": "bench_compare",
+        "old_metric": old.get("metric"),
+        "new_metric": new.get("metric"),
+        "ok": not regressions,
+        "regressions": regressions,
+        "checks": checks,
+    }
+
+
+def run_compare(old_path, new_path, threshold: float) -> int:
+    old = load_bench_json(old_path)
+    new = load_bench_json(new_path)
+    if old.get("metric") != new.get("metric"):
+        print(f"# warning: comparing different metrics "
+              f"{old.get('metric')!r} vs {new.get('metric')!r}",
+              file=sys.stderr)
+    report = compare_bench(old, new, threshold=threshold)
+    for c in report["checks"]:
+        flag = "REGRESSED" if c["regressed"] else "ok"
+        print(f"# {c['name']:<28} {c['old']:>12} -> {c['new']:>12} "
+              f"({c['delta_pct']:+.1f}% vs ±{c['threshold_pct']:.0f}%) "
+              f"{flag}", file=sys.stderr)
+    print(json.dumps(report), flush=True)
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", choices=[*CONFIGS, "all"], default="all")
@@ -1299,7 +1402,22 @@ def main(argv=None):
         "record them in BASELINE_MEASURED.json as the fixed vs_baseline "
         "denominators (host-only; no device work)",
     )
+    ap.add_argument(
+        "--compare", nargs=2, metavar=("OLD.json", "NEW.json"),
+        help="regression gate: compare two recorded bench JSON lines "
+             "(epochs/s, latency p50/p99, per-phase attribution) and "
+             "exit nonzero if NEW regressed past the threshold",
+    )
+    ap.add_argument(
+        "--compare-threshold", type=float, default=0.15,
+        help="relative regression threshold for --compare "
+             "(default 0.15 = 15%%; per-phase attribution gates at 2x)",
+    )
     args = ap.parse_args(argv)
+
+    if args.compare:
+        raise SystemExit(run_compare(args.compare[0], args.compare[1],
+                                     args.compare_threshold))
 
     if args.freeze_baselines:
         freeze_baselines()

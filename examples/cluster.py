@@ -79,8 +79,13 @@ def main() -> None:
     ap.add_argument("--base-port", type=int, default=0,
                     help="0 → pick a free range automatically")
     ap.add_argument("--metrics-base-port", type=int, default=0,
-                    help="obs endpoints (/metrics /status /spans) at "
-                         "base+i; 0 → auto (node ports + n); -1 → off")
+                    help="obs endpoints (/metrics /status /spans "
+                         "/flight) at base+i; 0 → auto (node ports + n); "
+                         "-1 → off")
+    ap.add_argument("--flight-dir", default="",
+                    help="flight-recorder journal root (node i journals "
+                         "to <dir>/node-i); empty → auto temp dir; "
+                         "'off' → disable the recorder")
     ap.add_argument("--encrypt", action="store_true",
                     help="TPKE-encrypt contributions (EncryptionSchedule "
                          "always instead of never)")
@@ -96,10 +101,21 @@ def main() -> None:
         metrics_base = args.metrics_base_port or base + args.nodes
     if args.metrics_base_port == -1:
         metrics_base = 0
+    # flight recorder on by default: every run leaves an auditable
+    # black-box journal behind
+    if args.flight_dir == "off":
+        flight_dir = ""
+    elif args.flight_dir:
+        flight_dir = args.flight_dir
+    else:
+        import tempfile
+
+        flight_dir = tempfile.mkdtemp(prefix="hbbft-flight-")
     cfg = ClusterConfig(
         n=args.nodes, seed=args.seed, base_port=base,
         metrics_base_port=metrics_base,
         batch_size=args.batch_size, encrypt=args.encrypt,
+        flight_dir=flight_dir,
     )
     print(f"spawning {cfg.n} node processes on "
           f"{cfg.host}:{cfg.base_port}..{cfg.base_port + cfg.n - 1}…")
@@ -108,6 +124,9 @@ def main() -> None:
               f"{metrics_base + cfg.n - 1}/metrics — watch live with\n"
               f"    python -m hbbft_tpu.obs.top "
               f"--base-port {metrics_base} --nodes {cfg.n}")
+    if flight_dir:
+        print(f"flight journals: {flight_dir} — audit offline with\n"
+              f"    python -m hbbft_tpu.obs.audit {flight_dir}")
     procs = {nid: spawn_node(cfg, nid) for nid in range(cfg.n)}
 
     async def session():

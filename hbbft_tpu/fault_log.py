@@ -66,6 +66,27 @@ class FaultKind(enum.Enum):
     # evidence names a different root than its full Echo)
 
 
+def equivocation_kinds() -> frozenset:
+    """The :class:`FaultKind` variants that denote *equivocation* — one
+    sender emitting conflicting values for the same protocol slot — as
+    opposed to merely invalid or mistimed input.  This is the evidence
+    class the forensic auditor (:mod:`hbbft_tpu.obs.audit`) can
+    reconstruct from merged per-node journals: two receivers holding
+    different values from the same sender for one slot is proof of
+    misbehavior regardless of which value is "right"."""
+    return frozenset({
+        FaultKind.MultipleValues,
+        FaultKind.MultipleEchos,
+        FaultKind.MultipleEchoHashes,
+        FaultKind.MultipleCanDecodes,
+        FaultKind.MultipleReadys,
+        FaultKind.MultipleConf,
+        FaultKind.MultipleTerm,
+        FaultKind.MultipleSignatureShares,
+        FaultKind.MultipleDecryptionShares,
+    })
+
+
 @dataclass(frozen=True)
 class Fault:
     """One piece of evidence: ``node_id`` did ``kind``.

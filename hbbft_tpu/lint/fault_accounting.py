@@ -8,12 +8,16 @@ a swallowed exception is an invisible Byzantine symptom.
   (..., Exception): pass``).  If ignoring really is correct, write
   ``contextlib.suppress(...)`` (greppable, reviewable) — or a narrow
   exception type plus an accounting call.
-- ``fault-swallowed-drop`` (``net/`` only) — an ``except`` handler that
-  neither re-raises nor performs any *accounting*: a counter increment
-  (``x += 1``, ``.inc()``, ``.observe()``), a ``record_*``/``*_count``/
-  ``*backoff*``/``*fail*``/``*fault*`` call, or a raise.  Logging alone
-  does not count — logs are not scrapeable, and the whole point of the
-  fault counters is that a drop path shows up in ``/metrics``.
+- ``fault-swallowed-drop`` (``net/`` and ``obs/``) — an ``except`` handler
+  that neither re-raises nor performs any *accounting*: a counter
+  increment (``x += 1``, ``.inc()``, ``.observe()``), a ``record_*``/
+  ``*_count``/``*backoff*``/``*fail*``/``*fault*`` call, or a raise.
+  Logging alone does not count — logs are not scrapeable, and the whole
+  point of the fault counters is that a drop path shows up in
+  ``/metrics``.  ``obs/`` is in scope since the flight recorder: a
+  journal that silently drops records on disk errors is a black box that
+  lies, so its failure paths must count
+  ``hbbft_obs_flight_write_failures_total`` (and friends).
 """
 
 from __future__ import annotations
@@ -79,12 +83,14 @@ class FaultAccountingChecker(Checker):
             "bare/broad `except: pass` — use contextlib.suppress(...) or "
             "a narrow type plus accounting",
         "fault-swallowed-drop":
-            "except handler in net/ drops input with no accounting "
-            "(no raise, no counter increment, no record_*/backoff call)",
+            "except handler in net/ or obs/ drops input with no "
+            "accounting (no raise, no counter increment, no "
+            "record_*/backoff call)",
     }
 
-    #: the drop rule only applies here — peer/client input paths
-    DROP_SCOPE = ("hbbft_tpu/net/",)
+    #: the drop rule only applies here — peer/client input paths, and
+    #: the flight recorder's journal-durability paths
+    DROP_SCOPE = ("hbbft_tpu/net/", "hbbft_tpu/obs/")
 
     def check_module(self, mod: ModuleSource) -> Iterable[Finding]:
         tree = mod.tree

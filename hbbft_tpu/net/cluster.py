@@ -54,10 +54,18 @@ class ClusterConfig:
     heartbeat_s: float = 0.5
     dead_after_s: float = 3.0
     replay_retain_epochs: int = 64
-    # obs endpoint (/metrics /status /spans) base port: node i serves on
-    # metrics_base_port + i; 0 → no fixed obs ports (LocalCluster still
-    # opens ephemeral ones)
+    # obs endpoint (/metrics /status /spans /flight) base port: node i
+    # serves on metrics_base_port + i; 0 → no fixed obs ports
+    # (LocalCluster still opens ephemeral ones)
     metrics_base_port: int = 0
+    # in-memory ledger-digest chain retention (the head + total length
+    # never truncate; the flight journal keeps the full record on disk)
+    digest_chain_retain: int = 4096
+    # flight-recorder journal root: node i journals to
+    # <flight_dir>/node-<i>; "" → recorder off
+    flight_dir: str = ""
+    flight_max_segment_bytes: int = 4 * 2**20
+    flight_max_segments: int = 16
 
     @property
     def cluster_id(self) -> bytes:
@@ -76,6 +84,11 @@ class ClusterConfig:
         if self.metrics_base_port == 0:
             raise ValueError("metrics_base_port 0 has no fixed addresses")
         return (self.host, self.metrics_base_port + nid)
+
+    def node_flight_dir(self, nid: int) -> Optional[str]:
+        if not self.flight_dir:
+            return None
+        return os.path.join(self.flight_dir, f"node-{nid}")
 
 
 def generate_infos(cfg: ClusterConfig) -> Dict[int, NetworkInfo]:
@@ -113,6 +126,10 @@ def build_runtime(cfg: ClusterConfig, infos: Dict[int, NetworkInfo],
         heartbeat_s=cfg.heartbeat_s,
         dead_after_s=cfg.dead_after_s,
         replay_retain_epochs=cfg.replay_retain_epochs,
+        digest_chain_retain=cfg.digest_chain_retain,
+        flight_dir=cfg.node_flight_dir(nid),
+        flight_max_segment_bytes=cfg.flight_max_segment_bytes,
+        flight_max_segments=cfg.flight_max_segments,
         **kwargs,
     )
 
@@ -175,17 +192,22 @@ class LocalCluster:
         await asyncio.wait_for(_wait(), timeout_s)
 
     def common_digest_prefix(self) -> List[str]:
-        """The agreed ledger-digest chain prefix across all runtimes; raises
-        if any node's chain *conflicts* (same index, different digest)."""
-        chains = [rt.digest_chain for rt in self.runtimes]
+        """The agreed ledger-digest chain across all runtimes wherever
+        their RETAINED chains overlap (chains are checkpointed — see
+        ``NodeRuntime.digest_chain_retain``); raises if any node's chain
+        *conflicts* (same index, different digest)."""
+        tails = [(rt.digest_chain_offset, rt.digest_chain)
+                 for rt in self.runtimes]
+        lo = max(off for off, _c in tails)
+        hi = min(off + len(c) for off, c in tails)
         prefix: List[str] = []
-        for i in range(min(len(c) for c in chains)):
-            vals = {c[i] for c in chains}
+        for i in range(lo, hi):
+            vals = {c[i - off] for off, c in tails}
             if len(vals) != 1:
                 raise AssertionError(
                     f"ledger fork at batch {i}: {sorted(vals)}"
                 )
-            prefix.append(chains[0][i])
+            prefix.append(tails[0][1][i - tails[0][0]])
         return prefix
 
 
@@ -241,6 +263,8 @@ def node_command(cfg: ClusterConfig, nid: int) -> List[str]:
     ]
     if cfg.metrics_base_port:
         cmd += ["--metrics-port", str(cfg.metrics_base_port + nid)]
+    if cfg.flight_dir:
+        cmd += ["--flight-dir", cfg.flight_dir]
     if cfg.encrypt:
         cmd.append("--encrypt")
     return cmd
@@ -299,19 +323,25 @@ async def run_node(cfg: ClusterConfig, nid: int,
     """Run one node forever (the subprocess entry body)."""
     infos = generate_infos(cfg)
     rt = build_runtime(cfg, infos, nid)
-    host, port = cfg.addr(nid)
-    await rt.start(host, port)
-    if metrics_port:
-        m_host, m_port = await rt.start_obs(host, metrics_port)
-        print(f"node {nid} obs endpoint on http://{m_host}:{m_port}"
-              f"/metrics", flush=True)
-    rt.connect(cfg.addr_map())
-    stop = asyncio.Event()
-    loop = asyncio.get_running_loop()
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        loop.add_signal_handler(sig, stop.set)
-    print(f"node {nid} listening on {host}:{port}", flush=True)
-    await stop.wait()
+    try:
+        host, port = cfg.addr(nid)
+        await rt.start(host, port)
+        if metrics_port:
+            m_host, m_port = await rt.start_obs(host, metrics_port)
+            print(f"node {nid} obs endpoint on http://{m_host}:{m_port}"
+                  f"/metrics", flush=True)
+        rt.connect(cfg.addr_map())
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        print(f"node {nid} listening on {host}:{port}", flush=True)
+        await stop.wait()
+    except BaseException as exc:
+        # crash-dump flush: make the black box land on disk before the
+        # process dies, whatever killed it
+        rt.flight_crash(exc)
+        raise
     await rt.stop()
 
 
@@ -327,14 +357,18 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--batch-size", type=int, default=8)
     ap.add_argument("--encrypt", action="store_true")
     ap.add_argument("--metrics-port", type=int, default=0,
-                    help="serve /metrics /status /spans on this port "
-                         "(0 = off)")
+                    help="serve /metrics /status /spans /flight on this "
+                         "port (0 = off)")
+    ap.add_argument("--flight-dir", default="",
+                    help="flight-recorder journal ROOT (this node "
+                         "journals to <dir>/node-<id>; empty = off)")
     args = ap.parse_args(argv)
     if not 0 <= args.node_id < args.nodes:
         ap.error(f"--node-id {args.node_id} not in 0..{args.nodes - 1}")
     cfg = ClusterConfig(
         n=args.nodes, seed=args.seed, base_port=args.base_port,
         batch_size=args.batch_size, encrypt=args.encrypt,
+        flight_dir=args.flight_dir,
     )
     asyncio.run(run_node(cfg, args.node_id,
                          metrics_port=args.metrics_port))

@@ -34,11 +34,13 @@ import hashlib
 import json
 import logging
 import struct
+import time
 from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
 
 from hbbft_tpu.net import framing
 from hbbft_tpu.net.client import Mempool, tx_digest
 from hbbft_tpu.net.transport import ClientConn, Transport
+from hbbft_tpu.obs.flight import FlightObserver, FlightRecorder
 from hbbft_tpu.obs.http import ObsServer
 from hbbft_tpu.obs.metrics import MetricAttr, Registry, fault_counter
 from hbbft_tpu.obs.spans import SpanTracer
@@ -63,36 +65,6 @@ Addr = Tuple[str, int]
 logger = logging.getLogger("hbbft_tpu.net")
 
 
-def _change_state_bytes(cs: Any) -> bytes:
-    """The batch's validator-set change decision is consensus output too —
-    a fork in DKG/membership state must show in the ledger digest."""
-    out = wire.blob(cs.state.encode())
-    out += cs.change.to_bytes() if cs.change is not None else b"\x00"
-    return out
-
-
-def _batch_bytes(b: Any) -> bytes:
-    """Canonical bytes of a committed batch for the ledger digest chain."""
-    if isinstance(b, QhbBatch):
-        out = b"qhb" + wire.u64(b.era) + wire.u64(b.epoch)
-        for proposer, txs in b.contributions:
-            out += wire.node_id(proposer) + wire.u32(len(txs))
-            for tx in txs:
-                out += wire.blob(tx)
-        return out + _change_state_bytes(b.change)
-    if isinstance(b, DhbBatch):
-        out = b"dhb" + wire.u64(b.era) + wire.u64(b.epoch)
-        for proposer, payload in b.contributions:
-            out += wire.node_id(proposer) + wire.blob(payload)
-        return out + _change_state_bytes(b.change)
-    if isinstance(b, HbBatch):
-        out = b"hb" + wire.u64(b.epoch)
-        for proposer, payload in b.contributions:
-            out += wire.node_id(proposer) + wire.blob(payload)
-        return out
-    raise TypeError(f"unknown batch type {type(b).__name__}")
-
-
 class NodeRuntime:
     """One networked consensus node: SenderQueue-wrapped algorithm +
     :class:`Transport` + client admission."""
@@ -110,6 +82,10 @@ class NodeRuntime:
         trace=None,
         cost_model=None,
         registry: Optional[Registry] = None,
+        digest_chain_retain: int = 4096,
+        flight_dir: Optional[str] = None,
+        flight_max_segment_bytes: int = 4 * 2**20,
+        flight_max_segments: int = 16,
         **transport_kwargs,
     ):
         self.sq = algo if isinstance(algo, SenderQueue) else SenderQueue(algo)
@@ -153,7 +129,26 @@ class NodeRuntime:
         self.on_batch = on_batch
         self.batches: List[Any] = []
         self.ledger_digest = b"\x00" * 32
-        self.digest_chain: List[str] = []
+        # the digest chain is CHECKPOINTED, not unbounded: only the last
+        # `digest_chain_retain` entries stay in memory; `chain_len` (the
+        # total) and `ledger_digest` (the head) never truncate, and the
+        # flight journal keeps the full per-batch record on disk
+        self.digest_chain_retain = max(1, digest_chain_retain)
+        self._digest_chain: List[str] = []
+        self._digest_chain_offset = 0
+        # black-box flight recorder (obs.flight): journals every message,
+        # commit, fault, span and lifecycle event for offline forensics
+        self.flight: Optional[FlightObserver] = None
+        if flight_dir:
+            recorder = FlightRecorder(
+                flight_dir, node=repr(self.sq.our_id()),
+                flavor="runtime", clock=time.time,
+                max_segment_bytes=flight_max_segment_bytes,
+                max_segments=flight_max_segments,
+                registry=self.registry,
+            )
+            self.flight = FlightObserver(recorder)
+            self.spans.sink = self.flight.record_span
         # per-peer replay log of recently sent consensus messages, in send
         # order: the reinit_peer history (see module docstring).  The
         # companion set dedups by value so reinit re-sends don't duplicate
@@ -189,6 +184,21 @@ class NodeRuntime:
     decode_failures = MetricAttr("_c_decode")
     send_failures = MetricAttr("_c_send_fail")
     replay_gaps = MetricAttr("_c_replay_gaps")
+
+    @property
+    def digest_chain(self) -> List[str]:
+        """The RETAINED tail of the ledger-digest chain (see
+        :attr:`digest_chain_offset` for where it starts)."""
+        return self._digest_chain
+
+    @property
+    def digest_chain_offset(self) -> int:
+        return self._digest_chain_offset
+
+    @property
+    def chain_len(self) -> int:
+        """Total batches folded into the digest chain (never truncates)."""
+        return self._digest_chain_offset + len(self._digest_chain)
 
     @property
     def faults_observed(self) -> int:
@@ -235,6 +245,8 @@ class NodeRuntime:
             self.registry,
             status_fn=self.status_doc,
             spans_fn=self.spans.export_jsonl,
+            flight_fn=(self.flight.recorder.tail_jsonl
+                       if self.flight is not None else None),
         )
         self.obs_addr = await self._obs_server.start(host, port)
         return self.obs_addr
@@ -261,6 +273,16 @@ class NodeRuntime:
         if self._obs_server is not None:
             await self._obs_server.stop()
         await self.transport.stop()
+        if self.flight is not None:
+            self.flight.close()
+
+    def flight_crash(self, exc: BaseException) -> None:
+        """Crash-dump flush: journal the fatal error and force the
+        journal to disk before the process dies (the note/flush path is
+        what makes a SIGKILL-adjacent crash auditable)."""
+        if self.flight is not None:
+            self.flight.on_note("crash", repr(exc))
+            self.flight.recorder.flush()
 
     # -- consensus plumbing --------------------------------------------------
 
@@ -284,6 +306,8 @@ class NodeRuntime:
                            type(msg).__name__, peer_id)
             return
         self.spans.on_message(peer_id, msg)
+        if self.flight is not None:
+            self.flight.on_message(peer_id, msg)
         try:
             step = self.sq.handle_message(peer_id, msg)
         except TypeError as exc:
@@ -325,6 +349,11 @@ class NodeRuntime:
         if history and min(e[0] for e in history) > (key[0],
                                                      key[1] + window):
             self.replay_gaps += 1
+            if self.flight is not None:
+                self.flight.on_note(
+                    "replay_gap",
+                    f"peer={peer_id!r} announced={key!r} "
+                    f"oldest_retained={min(e[0] for e in history)!r}")
             logger.error(
                 "peer %r announced %r but the replay log only reaches "
                 "back to %r (> window %d): retention does not cover its "
@@ -334,13 +363,21 @@ class NodeRuntime:
         self._absorb(self.sq.reinit_peer(peer_id, key, history))
 
     def _absorb(self, step: Step) -> None:
-        for fault in step.fault_log:
-            self._c_faults.labels(kind=fault.kind.name).inc()
-        self.spans.on_step(step)
-        for out in step.output:
-            if isinstance(out, (QhbBatch, DhbBatch, HbBatch)):
-                self._on_batch(out)
-        self._dispatch(step)
+        try:
+            for fault in step.fault_log:
+                self._c_faults.labels(kind=fault.kind.name).inc()
+            self.spans.on_step(step)
+            if self.flight is not None:
+                self.flight.on_step(step)
+            for out in step.output:
+                if isinstance(out, (QhbBatch, DhbBatch, HbBatch)):
+                    self._on_batch(out)
+            self._dispatch(step)
+        except Exception as exc:
+            # fatal in the consensus path: flush the black box so the
+            # journal's last records survive whatever happens next
+            self.flight_crash(exc)
+            raise
 
     def _dispatch(self, step: Step) -> None:
         our = self.our_id()
@@ -394,9 +431,13 @@ class NodeRuntime:
     def _on_batch(self, batch: Any) -> None:
         self.batches.append(batch)
         self.ledger_digest = hashlib.sha3_256(
-            self.ledger_digest + _batch_bytes(batch)
+            self.ledger_digest + wire.batch_bytes(batch)
         ).digest()
-        self.digest_chain.append(self.ledger_digest.hex())
+        self._digest_chain.append(self.ledger_digest.hex())
+        if len(self._digest_chain) > self.digest_chain_retain:
+            drop = len(self._digest_chain) - self.digest_chain_retain
+            del self._digest_chain[:drop]
+            self._digest_chain_offset += drop
         if isinstance(batch, QhbBatch):
             txs = batch.all_txs()
             self._c_committed.inc(len(txs))
@@ -435,15 +476,21 @@ class NodeRuntime:
 
     def status_doc(self, chain_tail: int = 256) -> dict:
         era, epoch = self.current_key()
-        offset = max(0, len(self.digest_chain) - chain_tail)
+        local = max(0, len(self._digest_chain) - chain_tail)
         return {
             "node": repr(self.our_id()),
             "era": era,
             "epoch": epoch,
             "batches": len(self.batches),
             "ledger": self.ledger_digest.hex(),
-            "digest_chain": self.digest_chain[offset:],
-            "digest_chain_offset": offset,
+            # chain head + total length: what the forensic auditor
+            # cross-checks against a live node without the full journal
+            "chain_head": self.ledger_digest.hex(),
+            "chain_len": self.chain_len,
+            "digest_chain": self._digest_chain[local:],
+            "digest_chain_offset": self._digest_chain_offset + local,
+            "flight": (self.flight.recorder.stats_doc()
+                       if self.flight is not None else None),
             "committed_txs": self.committed_txs,
             "mempool": len(self.mempool),
             "decode_failures": self.decode_failures,

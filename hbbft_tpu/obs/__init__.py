@@ -16,12 +16,21 @@ Thetacrypt treats a built-in metrics service as table stakes.
   RBC Value/Echo/Ready, per-ABA-round BVal/Aux/Conf + coin, threshold-decrypt
   share/combine, and DKG rotation, exportable as JSONL;
 - :mod:`hbbft_tpu.obs.http` — the asyncio ``/metrics``, ``/status``,
-  ``/spans`` endpoint every :class:`~hbbft_tpu.net.runtime.NodeRuntime`
-  serves;
+  ``/spans``, ``/flight`` endpoint every
+  :class:`~hbbft_tpu.net.runtime.NodeRuntime` serves;
 - :mod:`hbbft_tpu.obs.top` — ``python -m hbbft_tpu.obs.top``, a curses-free
-  live cluster view polling all nodes.
+  live cluster view polling all nodes;
+- :mod:`hbbft_tpu.obs.flight` — the black-box flight recorder: a bounded
+  segment-rotated on-disk journal of protocol events (messages, commits
+  with the ledger-digest chain, faults, spans, lifecycle notes), identical
+  format from both ``VirtualNet`` and ``NodeRuntime``;
+- :mod:`hbbft_tpu.obs.audit` — ``python -m hbbft_tpu.obs.audit``, the
+  cross-node forensic auditor: merged causal timeline, digest-chain
+  agreement, first-divergent-epoch fork reports, equivocation evidence
+  keyed to ``FaultKind``.
 """
 
+from hbbft_tpu.obs.flight import FlightObserver, FlightRecorder
 from hbbft_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -34,6 +43,8 @@ from hbbft_tpu.obs.spans import Span, SpanTracer
 
 __all__ = [
     "Counter",
+    "FlightObserver",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "Registry",

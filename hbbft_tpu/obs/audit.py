@@ -1,0 +1,504 @@
+"""Cross-node forensic audit of flight-recorder journals.
+
+``python -m hbbft_tpu.obs.audit DIR [DIR ...]`` merges the per-node
+journals written by :mod:`hbbft_tpu.obs.flight` (each ``DIR`` is one
+node's journal directory, or a parent holding ``node-*/`` journal
+directories) and answers the operator questions a live ``/metrics``
+scrape cannot:
+
+- **causal cluster timeline** — every journaled event of every node,
+  merged into one deterministic order (era, epoch, then a canonical
+  event key), with sends matched to their receives by payload digest +
+  target coverage.  Two audits of journals from the same deterministic
+  run produce byte-identical timelines (``--timeline``);
+- **agreement invariants** — all nodes' ledger-digest chains must agree
+  wherever they overlap (including a node's own chain across restarts:
+  replay/catch-up must rebuild the *identical* prefix), and committed
+  (era, epoch) keys must be strictly monotone per node incarnation.  On
+  a fork the report names the **first divergent epoch** and prints the
+  surrounding event window instead of a wall of hashes;
+- **equivocation evidence** — conflicting protocol messages from one
+  sender for the same slot (two Merkle roots for one RBC instance, two
+  Conf values for one ABA round, two decryption shares for one
+  ciphertext…), reconstructed from the *receivers'* journals and keyed
+  to the matching :class:`~hbbft_tpu.fault_log.FaultKind` variant, with
+  the first affected epoch — the slashing-grade artifact.
+
+Verdict: ``clean`` (all invariants hold), ``fork`` (digest chains
+disagree), or ``fault`` (equivocation / monotonicity evidence, chains
+intact).  Exit status 0 only on ``clean``.  Torn journal tails (crash
+mid-record) are skipped loudly and counted, never fatal.
+
+``--status HOST:PORT`` cross-checks a live node's ``/status`` chain head
++ length against its journal without needing the full chain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from hbbft_tpu.fault_log import FaultKind, equivocation_kinds
+from hbbft_tpu.obs.flight import (
+    FlightCommit,
+    FlightFault,
+    FlightMsg,
+    FlightNote,
+    FlightSpan,
+    Journal,
+    find_journal_dirs,
+    read_journal,
+    target_covers,
+)
+from hbbft_tpu.protocols import wire
+
+#: timeline ordering rank per record family (notes lead their epoch,
+#: then sends/receives, commits close it, spans/faults trail as derived)
+_RANK = {"note": 0, "msg": 1, "commit": 2, "span": 3, "fault": 4}
+
+
+def _digest(payload: bytes) -> str:
+    return hashlib.sha3_256(payload).hexdigest()[:16]
+
+
+# ===========================================================================
+# Equivocation slots
+# ===========================================================================
+
+
+def equivocation_key(msg: Any
+                     ) -> Optional[Tuple[Tuple, bytes, FaultKind]]:
+    """``(slot, value, FaultKind)`` for messages where one sender emitting
+    two *different* values for the same slot is proof of equivocation;
+    ``None`` for messages that may legitimately repeat with different
+    values (BVal/Aux vote for both sides honestly, EpochStarted
+    re-announces).  The slot includes everything that scopes the value;
+    the sender is supplied by the caller."""
+    from hbbft_tpu.protocols.binary_agreement import (
+        CoinMsg, ConfMsg, TermMsg,
+    )
+    from hbbft_tpu.protocols.broadcast import (
+        CanDecodeMsg, EchoHashMsg, EchoMsg, ReadyMsg, ValueMsg,
+    )
+    from hbbft_tpu.protocols.dynamic_honey_badger import HbWrap
+    from hbbft_tpu.protocols.honey_badger import (
+        DecryptionShareWrap, SubsetWrap,
+    )
+    from hbbft_tpu.protocols.sender_queue import AlgoMessage
+    from hbbft_tpu.protocols.subset import AgreementWrap, BroadcastWrap
+
+    era = 0
+    if isinstance(msg, AlgoMessage):
+        msg = msg.msg
+    if isinstance(msg, HbWrap):
+        era = msg.era
+        msg = msg.msg
+    if isinstance(msg, DecryptionShareWrap):
+        share = msg.msg.share
+        return ((era, msg.epoch, "decrypt", repr(msg.proposer_id)),
+                share.to_bytes(), FaultKind.MultipleDecryptionShares)
+    if not isinstance(msg, SubsetWrap):
+        return None
+    epoch = msg.epoch
+    inner = msg.msg
+    if isinstance(inner, BroadcastWrap):
+        proposer = repr(inner.proposer_id)
+        m = inner.msg
+        rules = (
+            (ValueMsg, "value", FaultKind.MultipleValues),
+            (EchoMsg, "echo", FaultKind.MultipleEchos),
+            (EchoHashMsg, "echo_hash", FaultKind.MultipleEchoHashes),
+            (CanDecodeMsg, "can_decode", FaultKind.MultipleCanDecodes),
+            (ReadyMsg, "ready", FaultKind.MultipleReadys),
+        )
+        for cls, tag, kind in rules:
+            if isinstance(m, cls):
+                root = m.proof.root_hash if isinstance(
+                    m, (ValueMsg, EchoMsg)) else m.root
+                return ((era, epoch, "rbc", proposer, tag), root, kind)
+        return None
+    if isinstance(inner, AgreementWrap):
+        proposer = repr(inner.proposer_id)
+        m = inner.msg
+        if isinstance(m, ConfMsg):
+            value = bytes([(False in m.values)
+                           | ((True in m.values) << 1)])
+            return ((era, epoch, "aba", proposer, "conf", m.epoch),
+                    value, FaultKind.MultipleConf)
+        if isinstance(m, TermMsg):
+            return ((era, epoch, "aba", proposer, "term"),
+                    b"\x01" if m.value else b"\x00",
+                    FaultKind.MultipleTerm)
+        if isinstance(m, CoinMsg):
+            inner_msg = m.msg
+            share = getattr(inner_msg, "share", None)
+            if share is not None:
+                return ((era, epoch, "aba", proposer, "coin", m.epoch),
+                        share.to_bytes(),
+                        FaultKind.MultipleSignatureShares)
+    return None
+
+
+# ===========================================================================
+# Audit
+# ===========================================================================
+
+
+@dataclass
+class Event:
+    """One timeline entry (sort-stable canonical key + display line)."""
+
+    era: int
+    epoch: int
+    rank: int
+    key: Tuple
+    line: str
+
+
+@dataclass
+class AuditResult:
+    nodes: List[str] = field(default_factory=list)
+    events: List[Event] = field(default_factory=list)
+    chains: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    first_divergence: Optional[Dict[str, Any]] = None
+    self_conflicts: List[Dict[str, Any]] = field(default_factory=list)
+    monotonicity_violations: List[Dict[str, Any]] = field(
+        default_factory=list)
+    equivocations: List[Dict[str, Any]] = field(default_factory=list)
+    unmatched_receives: int = 0
+    decode_failures: int = 0
+    torn_tails: int = 0
+    restarts: Dict[str, int] = field(default_factory=dict)
+    status_mismatches: List[str] = field(default_factory=list)
+
+    @property
+    def first_affected_epoch(self) -> Optional[Tuple[int, int]]:
+        keys = [(e["era"], e["epoch"]) for e in self.equivocations]
+        return min(keys) if keys else None
+
+    @property
+    def verdict(self) -> str:
+        if self.first_divergence or self.self_conflicts \
+                or self.status_mismatches:
+            return "fork"
+        if self.equivocations or self.monotonicity_violations:
+            return "fault"
+        return "clean"
+
+    def as_dict(self) -> Dict[str, Any]:
+        fa = self.first_affected_epoch
+        return {
+            "verdict": self.verdict,
+            "nodes": self.nodes,
+            "restarts": self.restarts,
+            "torn_tails": self.torn_tails,
+            "decode_failures": self.decode_failures,
+            "unmatched_receives": self.unmatched_receives,
+            "chains": {
+                n: {"head": c["head"], "len": c["len"]}
+                for n, c in self.chains.items()
+            },
+            "first_divergence": self.first_divergence,
+            "self_conflicts": self.self_conflicts,
+            "monotonicity_violations": self.monotonicity_violations,
+            "equivocations": self.equivocations,
+            "first_affected_epoch": list(fa) if fa else None,
+            "status_mismatches": self.status_mismatches,
+        }
+
+
+def audit(journals: List[Journal]) -> AuditResult:
+    """Merge journals, build the timeline, verify every invariant."""
+    res = AuditResult()
+    res.torn_tails = sum(j.torn_tails for j in journals)
+    res.nodes = [j.node for j in journals]
+    res.restarts = {j.node: max(0, j.starts - 1) for j in journals}
+
+    # -- outbound index: sender node → payload digest → [(inc, rec)] ---------
+    out_index: Dict[str, Dict[str, List[Tuple[int, FlightMsg]]]] = {}
+    for j in journals:
+        idx = out_index.setdefault(j.node, {})
+        for inc, rec in j.records:
+            if isinstance(rec, FlightMsg) and rec.direction == "out" \
+                    and rec.payload:
+                idx.setdefault(_digest(rec.payload), []).append(
+                    (inc, rec))
+
+    # -- walk every record: timeline + commits + equivocation slots ----------
+    # slots[(sender, slot)] = {value_digest: sorted set of witness nodes}
+    slots: Dict[Tuple, Dict[str, Any]] = {}
+    commits: Dict[str, Dict[int, Tuple[str, int, int, int]]] = {}
+    for j in journals:
+        node = j.node
+        per_index = commits.setdefault(node, {})
+        last_key: Dict[int, Tuple[int, int]] = {}  # inc → last (era, ep)
+        for inc, rec in j.records:
+            if isinstance(rec, FlightMsg):
+                d = _digest(rec.payload) if rec.payload else "-"
+                if rec.direction == "in":
+                    line = (f"era={rec.era} ep={rec.epoch} msg "
+                            f"{rec.mtype} {d} {rec.peer}->{node} "
+                            f"in@{node}#{inc}.{rec.seq}")
+                else:
+                    line = (f"era={rec.era} ep={rec.epoch} msg "
+                            f"{rec.mtype} {d} {node}->({rec.peer}) "
+                            f"out@{node}#{inc}.{rec.seq}")
+                res.events.append(Event(
+                    rec.era, rec.epoch, _RANK["msg"],
+                    (rec.mtype, d, 0 if rec.direction == "out" else 1,
+                     node, inc, rec.seq), line))
+                if rec.direction != "in" or not rec.payload:
+                    continue
+                # match the receive to a journaled send
+                sender = rec.peer
+                if sender in out_index:
+                    outs = out_index[sender].get(d, ())
+                    if not any(target_covers(o.peer, node)
+                               for _i, o in outs):
+                        res.unmatched_receives += 1
+                # equivocation slots are receiver-side evidence
+                try:
+                    msg = wire.decode_message(rec.payload)
+                except (ValueError, TypeError):
+                    res.decode_failures += 1
+                    continue
+                eq = equivocation_key(msg)
+                if eq is not None:
+                    slot, value, kind = eq
+                    vals = slots.setdefault((sender, slot, kind), {})
+                    vals.setdefault(
+                        _digest(value), set()).add(node)
+            elif isinstance(rec, FlightCommit):
+                dig = rec.digest.hex()
+                res.events.append(Event(
+                    rec.era, rec.epoch, _RANK["commit"],
+                    ("commit", rec.index, node, inc, rec.seq),
+                    f"era={rec.era} ep={rec.epoch} commit "
+                    f"idx={rec.index} {dig[:16]} @{node}#{inc}"))
+                prev = per_index.get(rec.index)
+                if prev is not None and prev[0] != dig:
+                    res.self_conflicts.append({
+                        "node": node, "index": rec.index,
+                        "digests": sorted((prev[0][:16], dig[:16])),
+                    })
+                else:
+                    per_index[rec.index] = (dig, rec.era, rec.epoch,
+                                            inc)
+                last = last_key.get(inc)
+                if last is not None and (rec.era, rec.epoch) <= last:
+                    res.monotonicity_violations.append({
+                        "node": node, "incarnation": inc,
+                        "prev": list(last),
+                        "next": [rec.era, rec.epoch],
+                    })
+                last_key[inc] = (rec.era, rec.epoch)
+            elif isinstance(rec, FlightFault):
+                res.events.append(Event(
+                    rec.era, rec.epoch, _RANK["fault"],
+                    ("fault", rec.kind, rec.node, node, inc, rec.seq),
+                    f"era={rec.era} ep={rec.epoch} fault {rec.kind} "
+                    f"by {rec.node} seen@{node}#{inc}"))
+            elif isinstance(rec, FlightSpan):
+                rnd = "-" if rec.round is None else rec.round
+                res.events.append(Event(
+                    rec.era, rec.epoch, _RANK["span"],
+                    ("span", rec.name, rnd, node, inc, rec.seq),
+                    f"era={rec.era} ep={rec.epoch} span {rec.name} "
+                    f"r={rnd} n={rec.count} @{node}#{inc}"))
+            elif isinstance(rec, FlightNote):
+                res.events.append(Event(
+                    0, 0, _RANK["note"],
+                    ("note", rec.kind, node, inc, rec.seq),
+                    f"note {rec.kind} {rec.detail} @{node}#{inc}"))
+    res.events.sort(key=lambda e: (e.era, e.epoch, e.rank, e.key))
+
+    # -- digest-chain agreement ----------------------------------------------
+    for node, per_index in commits.items():
+        if per_index:
+            top = max(per_index)
+            res.chains[node] = {
+                "len": top + 1,
+                "head": per_index[top][0],
+                "commits": per_index,
+            }
+    all_indices = sorted({i for c in commits.values() for i in c})
+    for i in all_indices:
+        present = {n: c[i] for n, c in commits.items() if i in c}
+        if len({v[0] for v in present.values()}) > 1:
+            res.first_divergence = {
+                "index": i,
+                "per_node": {
+                    n: {"digest": v[0][:16], "era": v[1], "epoch": v[2]}
+                    for n, v in sorted(present.items())
+                },
+                "era": min(v[1] for v in present.values()),
+                "epoch": min(v[2] for v in present.values()),
+            }
+            break
+
+    # -- equivocation evidence ----------------------------------------------
+    eq_kinds = equivocation_kinds()
+    for (sender, slot, kind), vals in sorted(
+            slots.items(), key=lambda kv: repr(kv[0])):
+        if len(vals) < 2:
+            continue
+        assert kind in eq_kinds
+        res.equivocations.append({
+            "sender": sender,
+            "kind": kind.name,
+            "era": slot[0],
+            "epoch": slot[1],
+            "slot": repr(slot),
+            "values": {d: sorted(w) for d, w in sorted(vals.items())},
+        })
+    return res
+
+
+def cross_check_status(res: AuditResult, doc: Dict[str, Any]) -> None:
+    """Compare a live node's ``/status`` chain head + length against its
+    journal (satellite of the bounded-digest-chain work: the auditor can
+    sanity-check a running node without pulling its full journal)."""
+    node = doc.get("node")
+    chain = res.chains.get(node)
+    if chain is None:
+        res.status_mismatches.append(
+            f"{node}: no journaled commits to cross-check")
+        return
+    live_len = doc.get("chain_len", doc.get("batches", 0))
+    tail = doc.get("digest_chain", [])
+    offset = doc.get("digest_chain_offset", 0)
+    overlap = [i for i in range(offset, offset + len(tail))
+               if i in chain["commits"]]
+    if not overlap:
+        res.status_mismatches.append(
+            f"{node}: journal (len {chain['len']}) and live chain "
+            f"(len {live_len}) do not overlap")
+        return
+    for i in overlap:
+        if chain["commits"][i][0] != tail[i - offset]:
+            res.status_mismatches.append(
+                f"{node}: journal digest at index {i} != live "
+                f"/status digest ({chain['commits'][i][0][:16]} vs "
+                f"{tail[i - offset][:16]})")
+            return
+
+
+# ===========================================================================
+# Report
+# ===========================================================================
+
+
+def format_report(res: AuditResult, timeline: bool = False,
+                  window: int = 4) -> str:
+    lines: List[str] = []
+    lines.append(f"flight audit: {len(res.nodes)} journals, "
+                 f"{len(res.events)} events, "
+                 f"{res.torn_tails} torn tails")
+    for node in res.nodes:
+        chain = res.chains.get(node)
+        head = f"len={chain['len']} head={chain['head'][:16]}" \
+            if chain else "no commits"
+        lines.append(f"  node {node}: restarts={res.restarts[node]} "
+                     f"{head}")
+    if timeline:
+        lines.append("-- timeline --")
+        lines.extend(e.line for e in res.events)
+    if res.first_divergence:
+        d = res.first_divergence
+        lines.append(f"FORK: first divergent epoch era={d['era']} "
+                     f"epoch={d['epoch']} (chain index {d['index']})")
+        for n, v in d["per_node"].items():
+            lines.append(f"  {n}: era={v['era']} epoch={v['epoch']} "
+                         f"digest={v['digest']}")
+        lines.append("-- event window around divergence --")
+        era, epoch = d["era"], d["epoch"]
+        for e in res.events:
+            if e.era == era and abs(e.epoch - epoch) <= window:
+                lines.append("  " + e.line)
+    for c in res.self_conflicts:
+        lines.append(f"SELF-FORK: {c['node']} rebuilt index "
+                     f"{c['index']} differently: {c['digests']}")
+    for v in res.monotonicity_violations:
+        lines.append(f"NON-MONOTONE: {v['node']}#{v['incarnation']} "
+                     f"committed {v['next']} after {v['prev']}")
+    for e in res.equivocations:
+        wit = "; ".join(f"{d}<-{','.join(w)}"
+                        for d, w in e["values"].items())
+        lines.append(f"EQUIVOCATION: {e['sender']} {e['kind']} "
+                     f"era={e['era']} epoch={e['epoch']} "
+                     f"slot={e['slot']} values: {wit}")
+    if res.equivocations:
+        era, epoch = res.first_affected_epoch
+        lines.append(f"first affected epoch: era={era} epoch={epoch}")
+    for m in res.status_mismatches:
+        lines.append(f"STATUS MISMATCH: {m}")
+    if res.unmatched_receives:
+        lines.append(f"note: {res.unmatched_receives} receives had no "
+                     f"matching journaled send (tampering, or the "
+                     f"sender's journal rotated past them)")
+    lines.append(f"verdict: {res.verdict}")
+    return "\n".join(lines) + "\n"
+
+
+def run_audit(paths: List[str]) -> Tuple[AuditResult, List[Journal]]:
+    dirs: List[str] = []
+    for p in paths:
+        found = find_journal_dirs(p)
+        if not found:
+            raise FileNotFoundError(f"no journal segments under {p!r}")
+        dirs.extend(found)
+    journals = [read_journal(d) for d in dirs]
+    return audit(journals), journals
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hbbft_tpu.obs.audit", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="+", metavar="DIR",
+                    help="journal directories (or parents of node-*/)")
+    ap.add_argument("--timeline", action="store_true",
+                    help="print the full merged causal timeline")
+    ap.add_argument("--json", action="store_true",
+                    help="print the verdict document as JSON")
+    ap.add_argument("--window", type=int, default=4,
+                    help="epochs of context around a divergence")
+    ap.add_argument("--status", action="append", default=[],
+                    metavar="HOST:PORT",
+                    help="cross-check a live node's /status chain head")
+    args = ap.parse_args(argv)
+    try:
+        res, _journals = run_audit(args.paths)
+    # hblint: disable=fault-swallowed-drop (CLI entry: exit status 2 is
+    # the accounting — there is no registry in an offline audit run)
+    except (FileNotFoundError, OSError) as exc:
+        print(f"audit: {exc}", file=sys.stderr)
+        return 2
+    for target in args.status:
+        from hbbft_tpu.obs.http import http_get
+
+        host, _, port = target.rpartition(":")
+        try:
+            doc = json.loads(http_get(host or "127.0.0.1", int(port),
+                                      "/status"))
+        # hblint: disable=fault-swallowed-drop (accounted: the appended
+        # status_mismatch flips the verdict to fork and the exit to 1)
+        except (OSError, ValueError) as exc:
+            res.status_mismatches.append(f"{target}: unreachable "
+                                         f"({exc!r})")
+            continue
+        cross_check_status(res, doc)
+    if args.json:
+        print(json.dumps(res.as_dict(), sort_keys=True))
+    else:
+        sys.stdout.write(format_report(res, timeline=args.timeline,
+                                       window=args.window))
+    return 0 if res.verdict == "clean" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

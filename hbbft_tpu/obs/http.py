@@ -5,7 +5,9 @@ One :class:`ObsServer` per node serves:
 - ``GET /metrics`` — Prometheus text format 0.0.4 from the node's registry;
 - ``GET /status``  — the runtime's JSON status document;
 - ``GET /spans``   — finished epoch-phase spans as JSONL
-  (``application/x-ndjson``), newest-bounded (see ``SpanTracer.max_spans``).
+  (``application/x-ndjson``), newest-bounded (see ``SpanTracer.max_spans``);
+- ``GET /flight``  — the flight recorder's in-memory record tail as JSONL
+  (payloads summarized as digest+size; the on-disk journal has the bytes).
 
 Deliberately tiny: request line + headers are read with a hard cap and a
 timeout, responses are ``Connection: close``, and anything but a known GET
@@ -34,10 +36,16 @@ class ObsServer:
     """Serve one registry (+ optional status/spans providers) over HTTP."""
 
     def __init__(self, registry, status_fn: Optional[Callable[[], dict]] = None,
-                 spans_fn: Optional[Callable[[], str]] = None):
+                 spans_fn: Optional[Callable[[], str]] = None,
+                 flight_fn: Optional[Callable[[], str]] = None):
         self.registry = registry
         self.status_fn = status_fn
         self.spans_fn = spans_fn
+        self.flight_fn = flight_fn
+        self._c_dropped = registry.counter(
+            "hbbft_obs_http_dropped_requests_total",
+            "obs-endpoint requests dropped (malformed, timed out, or "
+            "the client vanished mid-response)")
         self._server: Optional[asyncio.base_events.Server] = None
         self.addr: Optional[Addr] = None
 
@@ -67,8 +75,11 @@ class ObsServer:
         if path == "/spans":
             body = self.spans_fn() if self.spans_fn is not None else ""
             return (200, "application/x-ndjson", body)
+        if path == "/flight":
+            body = self.flight_fn() if self.flight_fn is not None else ""
+            return (200, "application/x-ndjson", body)
         return (404, "text/plain; charset=utf-8",
-                "not found; try /metrics /status /spans\n")
+                "not found; try /metrics /status /spans /flight\n")
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
@@ -101,6 +112,7 @@ class ObsServer:
             await writer.drain()
         except (asyncio.TimeoutError, asyncio.IncompleteReadError,
                 asyncio.LimitOverrunError, ValueError, OSError) as exc:
+            self._c_dropped.inc()
             logger.debug("obs request dropped: %r", exc)
         finally:
             # suppress: best-effort close of a possibly-dead diagnostics

@@ -139,6 +139,10 @@ class SpanTracer(StepObserver):
         self._done_set: set = set()
         self._dkg_open: Dict[int, _Agg] = {}
         self.epochs_finalized = 0
+        # optional per-span finalization hook: called with each Span the
+        # moment it is finished (the flight recorder journals them here;
+        # `finished` stays the bounded in-memory view)
+        self.sink: Optional[Any] = None
         r = self.registry
         self._h_phase = r.histogram(
             "hbbft_phase_duration_seconds",
@@ -260,6 +264,8 @@ class SpanTracer(StepObserver):
                 self._h_epoch.observe(s.duration_s)
             else:
                 self._h_phase.labels(phase=s.name).observe(s.duration_s)
+            if self.sink is not None:
+                self.sink(s)
         self.epochs_finalized += 1
         self._c_epochs.inc()
 
@@ -271,6 +277,8 @@ class SpanTracer(StepObserver):
                  count)
         self.finished.append(s)
         self._h_phase.labels(phase="dkg_rotation").observe(s.duration_s)
+        if self.sink is not None:
+            self.sink(s)
 
     # -- export --------------------------------------------------------------
 

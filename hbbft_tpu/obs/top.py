@@ -40,6 +40,8 @@ def poll_target(host: str, port: int, timeout_s: float = 2.0
     try:
         status = http_get(host, port, "/status", timeout_s)
         metrics = http_get(host, port, "/metrics", timeout_s)
+    # hblint: disable=fault-swallowed-drop (poller client side: a down
+    # node renders as the DOWN row — that IS the accounting)
     except (OSError, ValueError):
         return None
     import json
@@ -49,6 +51,8 @@ def poll_target(host: str, port: int, timeout_s: float = 2.0
             "status": json.loads(status),
             "metrics": parse_prometheus_text(metrics),
         }
+    # hblint: disable=fault-swallowed-drop (same: unparseable responses
+    # render the node as DOWN)
     except ValueError:
         return None
 
@@ -88,7 +92,8 @@ def render(targets: List[Target], prev: List[Optional[dict]],
     lines.append(
         f"{'node':<22} {'era':>4} {'epoch':>6} {'batch':>6} "
         f"{'ep/s':>6} {'mempool':>8} {'peers':>5} {'txs':>8} "
-        f"{'faults':>6} {'decode!':>7} {'gaps':>5}"
+        f"{'faults':>6} {'decode!':>7} {'gaps':>5} "
+        f"{'jrnl':>7} {'jseg':>4} {'jwf':>4}"
     )
     for i, (host, port) in enumerate(targets):
         snap = cur[i]
@@ -102,12 +107,19 @@ def render(targets: List[Target], prev: List[Optional[dict]],
             rate = "%.2f" % (
                 (d["batches"] - prev[i]["status"]["batches"]) / dt
             )
+        # journal health: flight-recorder records/segments/write-failures
+        # (the black box an operator audits after an incident — a nonzero
+        # jwf means the journal is losing events to disk errors)
+        fl = d.get("flight") or {}
+        jrnl = fl.get("records", "-")
+        jseg = fl.get("segments", "-")
+        jwf = fl.get("write_failures", "-")
         lines.append(
             f"{name:<22} {d['era']:>4} {d['epoch']:>6} "
             f"{d['batches']:>6} {rate:>6} {d['mempool']:>8} "
             f"{d['peers_connected']:>5} {d['committed_txs']:>8} "
             f"{d['faults_observed']:>6} {d['decode_failures']:>7} "
-            f"{d['replay_gaps']:>5}"
+            f"{d['replay_gaps']:>5} {jrnl:>7} {jseg:>4} {jwf:>4}"
         )
     pq = phase_quantiles(cur)
     lines.append("")
@@ -165,6 +177,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.iterations and i >= args.iterations:
                 break
             time.sleep(args.interval)
+    # hblint: disable=fault-swallowed-drop (interactive exit, not a
+    # dropped input: ^C ends the watch loop cleanly)
     except KeyboardInterrupt:
         pass
     return 0 if any(s is not None for s in prev) else 1

@@ -67,6 +67,9 @@ class Reader:
             )
         return self.take(n)
 
+    def f64(self) -> float:
+        return struct.unpack(">d", self.take(8))[0]
+
     def done(self) -> bool:
         return self.pos == len(self.data)
 
@@ -81,6 +84,12 @@ def u32(v: int) -> bytes:
 
 def u64(v: int) -> bytes:
     return struct.pack(">Q", v)
+
+
+def f64(v: float) -> bytes:
+    """IEEE-754 big-endian double — byte-deterministic for a given float
+    value (journal record timestamps/durations)."""
+    return struct.pack(">d", v)
 
 
 # -- node ids ---------------------------------------------------------------
@@ -146,6 +155,49 @@ def read_commitment_bivar(r: Reader) -> tc.BivarCommitment:
     return tc.BivarCommitment(degree, pts)
 
 
+# -- committed batches -------------------------------------------------------
+#
+# The canonical bytes every ledger-digest chain folds over.  Shared by
+# ``net.runtime.NodeRuntime`` and the flight recorder
+# (``obs.flight.FlightObserver``) so both drivers produce the SAME chain for
+# the same batch sequence — the cross-node/cross-driver identity the
+# forensic auditor compares.
+
+
+def _change_state_bytes(cs) -> bytes:
+    """The batch's validator-set change decision is consensus output too —
+    a fork in DKG/membership state must show in the ledger digest."""
+    out = blob(cs.state.encode())
+    out += cs.change.to_bytes() if cs.change is not None else b"\x00"
+    return out
+
+
+def batch_bytes(b) -> bytes:
+    """Canonical bytes of a committed batch for the ledger digest chain."""
+    from hbbft_tpu.protocols.dynamic_honey_badger import DhbBatch
+    from hbbft_tpu.protocols.honey_badger import Batch as HbBatch
+    from hbbft_tpu.protocols.queueing_honey_badger import QhbBatch
+
+    if isinstance(b, QhbBatch):
+        out = b"qhb" + u64(b.era) + u64(b.epoch)
+        for proposer, txs in b.contributions:
+            out += node_id(proposer) + u32(len(txs))
+            for tx in txs:
+                out += blob(tx)
+        return out + _change_state_bytes(b.change)
+    if isinstance(b, DhbBatch):
+        out = b"dhb" + u64(b.era) + u64(b.epoch)
+        for proposer, payload in b.contributions:
+            out += node_id(proposer) + blob(payload)
+        return out + _change_state_bytes(b.change)
+    if isinstance(b, HbBatch):
+        out = b"hb" + u64(b.epoch)
+        for proposer, payload in b.contributions:
+            out += node_id(proposer) + blob(payload)
+        return out
+    raise TypeError(f"unknown batch type {type(b).__name__}")
+
+
 # ===========================================================================
 # Full protocol-message wire format
 # ===========================================================================
@@ -176,7 +228,12 @@ def encode_message(msg) -> bytes:
     return bytes([tag]) + enc(msg)
 
 
-def decode_message(data: bytes, max_bytes: Optional[int] = None):
+def decode_message(data: bytes, max_bytes: Optional[int] = None,
+                   max_blob: Optional[int] = None):
+    """``max_blob`` overrides the Reader's per-blob cap — the journal
+    reader passes ``len(data)`` because its payloads are already
+    length-bounded and CRC-validated, and a legally-journaled message
+    near ``MAX_MESSAGE_BYTES`` embeds blobs above ``MAX_BLOB_BYTES``."""
     _lazy_register()
     if max_bytes is None:
         max_bytes = MAX_MESSAGE_BYTES
@@ -184,7 +241,7 @@ def decode_message(data: bytes, max_bytes: Optional[int] = None):
         raise ValueError(
             f"message of {len(data)} bytes exceeds cap {max_bytes}"
         )
-    r = Reader(data)
+    r = Reader(data, max_blob=max_blob)
     msg = _read_message(r)
     if not r.done():
         raise ValueError("trailing bytes after message")
@@ -362,6 +419,55 @@ def _lazy_register():
     _register(0x71, AlgoMessage,
               lambda m: encode_message(m.msg),
               lambda r: AlgoMessage(_read_message(r)))
+    # flight-recorder journal records ---------------------------------------
+    # Registered like any other message so the wire-completeness checker
+    # (frozen+hashable, tag uniqueness, codec pairs) and the per-type
+    # hash/round-trip regression in tests/test_wire.py cover the journal
+    # format for free.
+    from hbbft_tpu.obs.flight import (
+        FlightCommit, FlightFault, FlightHello, FlightMsg, FlightNote,
+        FlightSpan,
+    )
+
+    def s(text: str) -> bytes:
+        return blob(text.encode())
+
+    def rs(r: Reader) -> str:
+        return r.blob().decode()
+
+    _register(0x80, FlightHello,
+              lambda m: (s(m.node) + s(m.flavor) + u32(m.incarnation)
+                         + u64(m.seq) + f64(m.t)),
+              lambda r: FlightHello(rs(r), rs(r), r.u32(), r.u64(),
+                                    r.f64()))
+    _register(0x81, FlightMsg,
+              lambda m: (u64(m.seq) + f64(m.t) + s(m.direction)
+                         + s(m.peer) + u64(m.era) + u64(m.epoch)
+                         + s(m.mtype) + blob(m.payload)),
+              lambda r: FlightMsg(r.u64(), r.f64(), rs(r), rs(r),
+                                  r.u64(), r.u64(), rs(r), r.blob()))
+    _register(0x82, FlightCommit,
+              lambda m: (u64(m.seq) + f64(m.t) + u64(m.era)
+                         + u64(m.epoch) + u64(m.index) + blob(m.digest)),
+              lambda r: FlightCommit(r.u64(), r.f64(), r.u64(), r.u64(),
+                                     r.u64(), r.blob()))
+    _register(0x83, FlightFault,
+              lambda m: (u64(m.seq) + f64(m.t) + s(m.node) + s(m.kind)
+                         + u64(m.era) + u64(m.epoch)),
+              lambda r: FlightFault(r.u64(), r.f64(), rs(r), rs(r),
+                                    r.u64(), r.u64()))
+    _register(0x84, FlightSpan,
+              lambda m: (u64(m.seq) + f64(m.t) + s(m.name) + u64(m.era)
+                         + u64(m.epoch)
+                         + u64(0 if m.round is None else m.round + 1)
+                         + f64(m.t_start) + f64(m.t_end) + u64(m.count)),
+              lambda r: FlightSpan(r.u64(), r.f64(), rs(r), r.u64(),
+                                   r.u64(), (lambda v: v - 1 if v else
+                                             None)(r.u64()),
+                                   r.f64(), r.f64(), r.u64()))
+    _register(0x85, FlightNote,
+              lambda m: u64(m.seq) + f64(m.t) + s(m.kind) + s(m.detail),
+              lambda r: FlightNote(r.u64(), r.f64(), rs(r), rs(r)))
 
 
 def ensure_registered():

@@ -91,6 +91,60 @@ class MitmDelayAdversary(Adversary):
         return self.rng.randrange(len(net.queue))
 
 
+class EquivocatingAdversary(Adversary):
+    """A faulty node equivocates: the Merkle root carried by its
+    root-bearing broadcast messages (Ready/EchoHash/CanDecode, and the
+    proof roots of Value/Echo) is rewritten for HALF its peers, so odd-
+    and even-indexed destinations observe conflicting values for the same
+    RBC slot.  Delivery order stays FIFO — the point is not scheduling
+    pressure but producing the receiver-side evidence the forensic
+    auditor (``hbbft_tpu.obs.audit``) must reconstruct: two journals
+    holding different roots from one sender for one slot, keyed to the
+    ``Multiple*`` FaultKind family.
+
+    Deterministic (no RNG): the same run yields the same tampered bytes,
+    which the audit byte-identity tests rely on.
+    """
+
+    def tamper(self, net: "VirtualNet", msg: "NetworkMessage"):
+        from hbbft_tpu.sim.virtual_net import NetworkMessage
+
+        order = sorted(net.node_ids(), key=repr)
+        if order.index(msg.to) % 2 == 0:
+            return msg  # even destinations see the honest value
+        flipped = _flip_roots(msg.payload)
+        if flipped is None:
+            return msg
+        return NetworkMessage(msg.sender, msg.to, flipped)
+
+
+def _flip_roots(msg):
+    """A copy of ``msg`` with every embedded 32-byte broadcast root's
+    last bit flipped (walking the wrapper chain); None if the message
+    carries no root."""
+    import dataclasses
+
+    from hbbft_tpu.protocols.broadcast import (
+        CanDecodeMsg, EchoHashMsg, EchoMsg, ReadyMsg, ValueMsg,
+    )
+
+    def flip(root: bytes) -> bytes:
+        return root[:-1] + bytes([root[-1] ^ 1])
+
+    if isinstance(msg, (ReadyMsg, EchoHashMsg, CanDecodeMsg)):
+        return type(msg)(flip(msg.root))
+    if isinstance(msg, (ValueMsg, EchoMsg)):
+        proof = dataclasses.replace(msg.proof,
+                                    root_hash=flip(msg.proof.root_hash))
+        return type(msg)(proof)
+    if dataclasses.is_dataclass(msg) and hasattr(msg, "msg"):
+        inner = _flip_roots(msg.msg)
+        if inner is None:
+            return None
+        return dataclasses.replace(msg, msg=inner)
+    return None
+
+
 class RandomAdversary(Adversary):
     """Random delivery order with duplication, INJECTION, and TAMPERING.
 

@@ -154,6 +154,14 @@ class VirtualNet:
         while self.queue:
             self.crank()
 
+    def close_observers(self) -> None:
+        """Close any per-node observers that hold resources (the flight
+        recorder flushes + finalizes its journal here)."""
+        for obs in self.observers.values():
+            close = getattr(obs, "close", None)
+            if close is not None:
+                close()
+
     # -- internals ----------------------------------------------------------
 
     def _process_step(self, node: Node, step: Step) -> None:
@@ -225,6 +233,40 @@ class NetBuilder:
         the built observers are reachable as ``net.observers[node_id]``."""
         self._observer_factory = factory
         return self
+
+    def flight(self, journal_root: str, **recorder_kwargs) -> "NetBuilder":
+        """Attach a flight recorder per node: node ``i`` journals to
+        ``<journal_root>/node-<i>`` with a **logical clock** (record
+        sequence numbers), so the same deterministic schedule produces
+        byte-identical journals — the tier-1 way to audit a full run
+        offline (``python -m hbbft_tpu.obs.audit <journal_root>``).
+        Call :meth:`VirtualNet.close_observers` when the run ends."""
+        import os as _os
+
+        from hbbft_tpu.obs.flight import FlightObserver, FlightRecorder
+        from hbbft_tpu.obs.spans import SpanTracer
+
+        def logical_clock():
+            # per-node call counter: span timestamps must be as
+            # deterministic as the journal's record clock
+            state = [0.0]
+
+            def clock() -> float:
+                state[0] += 1.0
+                return state[0]
+
+            return clock
+
+        def factory(nid: NodeId):
+            rec = FlightRecorder(
+                _os.path.join(journal_root, f"node-{nid}"),
+                node=repr(nid), flavor="virtualnet", clock=None,
+                **recorder_kwargs,
+            )
+            return FlightObserver(
+                rec, spans=SpanTracer(node=nid, clock=logical_clock()))
+
+        return self.observe(factory)
 
     def using_step(self, make_algo: Callable[[NodeId], Any]) -> VirtualNet:
         nodes = {

@@ -214,6 +214,12 @@ class StepObserver:
     def on_step(self, step: "Step", t: Optional[float] = None) -> None:
         """The Step the protocol returned (outputs close epochs)."""
 
+    def on_note(self, kind: str, detail: str,
+                t: Optional[float] = None) -> None:
+        """An out-of-band driver lifecycle event (``start`` / ``restart``
+        / ``replay_gap`` / ``crash`` / ``stop``) — protocol-free context
+        the flight recorder journals alongside the message stream."""
+
 
 class ConsensusProtocol(abc.ABC, Generic[M, O]):
     """Abstract sans-I/O consensus state machine.

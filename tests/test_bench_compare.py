@@ -1,0 +1,106 @@
+"""``bench.py --compare OLD.json NEW.json`` — the regression gate over
+recorded ``BENCH_*.json`` trajectory files."""
+
+import json
+
+import pytest
+
+import bench
+
+
+def _line(value=20.0, p50=90.0, p99=100.0, wall=41.0, rbc=17.0,
+          aba=25.0):
+    return {
+        "metric": "net_qhb4_localhost",
+        "value": value,
+        "unit": "epochs/s",
+        "p50_latency_ms": p50,
+        "p99_latency_ms": p99,
+        "phases": {
+            "epoch_wall_p50_ms": wall,
+            "epoch_wall_p99_ms": wall + 10,
+            "rbc": {"attr_p50_ms": rbc},
+            "aba": {"attr_p50_ms": aba},
+            "coin": {"attr_p50_ms": None},      # absent phase: skipped
+            "decrypt": {"attr_p50_ms": None},
+        },
+    }
+
+
+def test_improvement_and_noise_pass():
+    old = _line()
+    new = _line(value=22.0, p50=85.0, wall=39.0)  # better
+    report = bench.compare_bench(old, new, threshold=0.15)
+    assert report["ok"] and report["regressions"] == []
+    # within-threshold noise passes too
+    new = _line(value=19.0, p50=95.0)  # ~5% worse: under the gate
+    assert bench.compare_bench(old, new, threshold=0.15)["ok"]
+
+
+def test_throughput_regression_fails():
+    report = bench.compare_bench(_line(), _line(value=14.0),
+                                 threshold=0.15)
+    assert not report["ok"] and report["regressions"] == ["value"]
+    check = [c for c in report["checks"] if c["name"] == "value"][0]
+    assert check["regressed"] and check["delta_pct"] == -30.0
+
+
+def test_latency_and_phase_attribution_regressions_fail():
+    report = bench.compare_bench(_line(), _line(p99=140.0),
+                                 threshold=0.15)
+    assert report["regressions"] == ["p99_latency_ms"]
+    # per-phase attribution gates at 2x threshold: +25% passes, +60%
+    # fails — "a phase silently doubling" is what the gate exists for
+    assert bench.compare_bench(_line(), _line(aba=31.0),
+                               threshold=0.15)["ok"]
+    report = bench.compare_bench(_line(), _line(aba=40.0),
+                                 threshold=0.15)
+    assert report["regressions"] == ["phases.aba.attr_p50_ms"]
+
+
+def test_value_direction_respects_unit():
+    # a seconds-per-epoch metric regresses UP, not down
+    old = {"metric": "m", "value": 4.5, "unit": "s"}
+    assert not bench.compare_bench(old, dict(old, value=6.0),
+                                   threshold=0.15)["ok"]
+    assert bench.compare_bench(old, dict(old, value=3.0),
+                               threshold=0.15)["ok"]
+
+
+def test_cli_exit_codes_and_report_line(tmp_path, capsys):
+    old_p = tmp_path / "old.json"
+    new_p = tmp_path / "new.json"
+    old_p.write_text(json.dumps(_line()))
+    new_p.write_text(json.dumps(_line(value=22.0)))
+    assert bench.run_compare(str(old_p), str(new_p), 0.15) == 0
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["metric"] == "bench_compare" and report["ok"]
+
+    new_p.write_text(json.dumps(_line(value=10.0)))
+    with pytest.raises(SystemExit) as exc:
+        bench.main(["--compare", str(old_p), str(new_p)])
+    assert exc.value.code == 1
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["regressions"] == ["value"]
+
+
+def test_load_bench_json_salvages_truncated_log_lines(tmp_path):
+    """A piped log whose final line was cut mid-write must not abort the
+    gate — the last COMPLETE object wins."""
+    p = tmp_path / "log.json"
+    p.write_text("# device: cpu\n" + json.dumps(_line()) + "\n"
+                 + '{"metric": "net_clu')
+    assert bench.load_bench_json(str(p))["metric"] == "net_qhb4_localhost"
+
+
+def test_real_recorded_trajectory_files_compare():
+    """The shipped BENCH_NET_r01 → r02 trajectory must load and produce
+    a verdict (this is the pair the gate exists to watch)."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    old = bench.load_bench_json(os.path.join(root, "BENCH_NET_r01.json"))
+    new = bench.load_bench_json(os.path.join(root, "BENCH_NET_r02.json"))
+    report = bench.compare_bench(old, new, threshold=0.5)
+    names = {c["name"] for c in report["checks"]}
+    assert "value" in names and "p50_latency_ms" in names

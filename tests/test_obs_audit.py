@@ -1,0 +1,255 @@
+"""Forensic audit over flight journals — the acceptance scenarios.
+
+- a deterministic 4-node VirtualNet run, recorded twice independently,
+  audits to byte-identical timelines and a clean verdict (the ``python
+  -m hbbft_tpu.obs.audit`` CLI included);
+- an equivocating adversary (``sim.adversary.EquivocatingAdversary``)
+  yields receiver-side evidence naming the faulty node, keyed to the
+  ``Multiple*`` FaultKind family, with the first affected epoch;
+- a forked journal reports the FIRST divergent epoch (not a crash), a
+  truncated journal reports torn tails and still audits clean;
+- commit monotonicity and live-``/status`` cross-checks flip the verdict.
+"""
+
+import contextlib
+import io
+import random
+
+import pytest
+
+from hbbft_tpu.fault_log import equivocation_kinds
+from hbbft_tpu.obs import audit
+from hbbft_tpu.obs.flight import FlightRecorder, read_journal
+from hbbft_tpu.protocols.dynamic_honey_badger import DynamicHoneyBadger
+from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
+from hbbft_tpu.protocols.queueing_honey_badger import (
+    QhbBatch,
+    QueueingHoneyBadger,
+    TxInput,
+)
+from hbbft_tpu.sim import NetBuilder, NullAdversary
+from hbbft_tpu.sim.adversary import EquivocatingAdversary
+
+
+def _run_recorded(infos, root, adversary=None, faulty=(), n=4, txs=8,
+                  max_cranks=60_000):
+    """A recorded QHB run, crank-BOUNDED: an equivocating proposer's own
+    txs can never commit, so its queue re-proposes forever and the run
+    never goes quiescent — honest Byzantine behavior, not a bug.  A
+    fixed crank budget keeps every configuration deterministic AND
+    finite (clean runs drain long before the bound)."""
+    builder = NetBuilder(list(range(n))).adversary(
+        adversary or NullAdversary()).faulty(list(faulty)).flight(root)
+    net = builder.using_step(
+        lambda nid: QueueingHoneyBadger(
+            DynamicHoneyBadger(
+                infos[nid], infos[nid].secret_key(),
+                rng=random.Random(100 + nid),
+                encryption_schedule=EncryptionSchedule.never(),
+            ),
+            batch_size=4, rng=random.Random(200 + nid),
+        )
+    )
+    for i in range(txs):
+        net.send_input(i % n, TxInput(b"audit-tx-%d" % i))
+    while net.queue and net.cranks < max_cranks:
+        net.crank()
+    net.close_observers()
+    return net
+
+
+@pytest.fixture(scope="module")
+def clean_runs(shared_netinfo, tmp_path_factory):
+    """The SAME deterministic schedule recorded twice, independently."""
+    infos = shared_netinfo(4, 13)
+    roots = []
+    for tag in ("a", "b"):
+        root = str(tmp_path_factory.mktemp(f"flight-{tag}"))
+        net = _run_recorded(infos, root)
+        assert sum(1 for o in net.nodes[0].outputs
+                   if isinstance(o, QhbBatch)) >= 2
+        roots.append(root)
+    return roots
+
+
+def _cli(args):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = audit.main(args)
+    return rc, buf.getvalue()
+
+
+def test_clean_run_audits_clean_and_byte_identical(clean_runs):
+    """Acceptance: two invocations over independently recorded journals
+    → byte-identical timelines, clean verdicts, exit status 0."""
+    outs = []
+    for root in clean_runs:
+        rc, out = _cli([root, "--timeline"])
+        assert rc == 0, out
+        assert out.endswith("verdict: clean\n")
+        assert "-- timeline --" in out and "commit idx=0" in out
+        outs.append(out)
+    assert outs[0] == outs[1]  # byte-identical
+    # all four chains agree and were actually compared
+    res, _ = audit.run_audit([clean_runs[0]])
+    assert len(res.chains) == 4
+    heads = {c["head"] for c in res.chains.values()}
+    assert len(heads) == 1
+    assert res.torn_tails == 0 and not res.equivocations
+    assert res.unmatched_receives == 0
+
+
+def test_audit_module_entry_point(clean_runs):
+    """The literal ``python -m hbbft_tpu.obs.audit`` invocation."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "hbbft_tpu.obs.audit", clean_runs[0],
+         "--json"],
+        capture_output=True, text=True, env=env, cwd=cwd, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    import json
+
+    doc = json.loads(proc.stdout)
+    assert doc["verdict"] == "clean" and len(doc["nodes"]) == 4
+
+
+def test_equivocating_adversary_is_named_with_first_epoch(
+        shared_netinfo, tmp_path):
+    """Audit-on-fault satellite: the equivocator's conflicting roots land
+    in the receivers' journals; the auditor names the node and the first
+    affected epoch, keyed to the Multiple* FaultKind family."""
+    infos = shared_netinfo(4, 13)
+    root = str(tmp_path / "flight-equiv")
+    net = _run_recorded(infos, root, adversary=EquivocatingAdversary(),
+                        faulty=[3])
+    # consensus survives f=1 equivocation: every correct node commits
+    for nid in (0, 1, 2):
+        assert sum(1 for o in net.nodes[nid].outputs
+                   if isinstance(o, QhbBatch)) >= 1
+    res, _ = audit.run_audit([root])
+    assert res.verdict == "fault"
+    assert res.equivocations
+    assert {e["sender"] for e in res.equivocations} == {"3"}
+    eq_names = {k.name for k in equivocation_kinds()}
+    assert {e["kind"] for e in res.equivocations} <= eq_names
+    # first affected epoch = the earliest slot with conflicting values
+    assert res.first_affected_epoch == min(
+        (e["era"], e["epoch"]) for e in res.equivocations)
+    # each piece of evidence shows >= 2 conflicting values with the
+    # witnessing receivers attached
+    for e in res.equivocations:
+        assert len(e["values"]) >= 2
+        witnesses = {w for ws in e["values"].values() for w in ws}
+        assert witnesses and "3" not in witnesses
+    # the report prints the culprit and the epoch, and the CLI exits 1
+    rc, out = _cli([root])
+    assert rc == 1
+    assert "EQUIVOCATION: 3 " in out and "first affected epoch" in out
+    assert out.endswith("verdict: fault\n")
+
+
+def test_truncated_journal_reports_torn_tail_not_crash(
+        clean_runs, tmp_path):
+    """Chop the newest segment of one node mid-record: the audit still
+    completes, counts the torn tail, and the verdict stays clean (the
+    tear loses records, it does not forge disagreement)."""
+    import os
+    import shutil
+
+    root = str(tmp_path / "flight-torn")
+    shutil.copytree(clean_runs[0], root)
+    node_dir = os.path.join(root, sorted(os.listdir(root))[0])
+    seg = sorted(n for n in os.listdir(node_dir)
+                 if n.endswith(".fjl"))[-1]
+    path = os.path.join(node_dir, seg)
+    size = os.path.getsize(path)
+    with open(path, "rb+") as fh:
+        fh.truncate(size - 11)  # mid-record, past the last boundary
+    res, _ = audit.run_audit([root])
+    assert res.torn_tails == 1
+    assert res.verdict == "clean"
+    rc, out = _cli([root])
+    assert rc == 0 and "1 torn tails" in out
+
+
+def test_forked_journals_report_first_divergent_epoch(tmp_path):
+    """Two synthetic nodes agree for 3 batches then fork: the auditor
+    reports the FIRST divergent epoch with per-node digests and prints
+    the surrounding event window."""
+    shared = [bytes([i]) * 32 for i in range(3)]
+    for node, fork_byte in (("0", 0xAA), ("1", 0xBB)):
+        rec = FlightRecorder(str(tmp_path / f"node-{node}"), node=node,
+                             clock=None)
+        for i, digest in enumerate(shared):
+            rec.record_commit(0, i, i, digest)
+        rec.record_commit(0, 3, 3, bytes([fork_byte]) * 32)  # fork!
+        rec.record_commit(0, 4, 4, bytes([fork_byte + 1]) * 32)
+        rec.close()
+    res, _ = audit.run_audit([str(tmp_path)])
+    assert res.verdict == "fork"
+    d = res.first_divergence
+    assert d["index"] == 3 and d["era"] == 0 and d["epoch"] == 3
+    assert set(d["per_node"]) == {"0", "1"}
+    rc, out = _cli([str(tmp_path)])
+    assert rc == 1
+    assert "FORK: first divergent epoch era=0 epoch=3" in out
+    assert "-- event window around divergence --" in out
+    assert out.endswith("verdict: fork\n")
+
+
+def test_restart_replaying_identical_chain_is_clean_but_selffork_is_not(
+        tmp_path):
+    """The kill-restart shape: incarnation 2 re-commits indices 0..k.
+    Identical digests (honest replay) stay clean; a different digest at
+    an already-journaled index is a self-fork."""
+    d = str(tmp_path / "node-0")
+    rec = FlightRecorder(d, node="0", clock=None)
+    for i in range(3):
+        rec.record_commit(0, i, i, bytes([i]) * 32)
+    rec.close()
+    rec = FlightRecorder(d, node="0", clock=None)  # restart
+    for i in range(4):  # replays 0..2 identically, extends to 3
+        rec.record_commit(0, i, i, bytes([i]) * 32)
+    rec.close()
+    res, _ = audit.run_audit([d])
+    assert res.restarts == {"0": 1}
+    assert res.verdict == "clean" and not res.monotonicity_violations
+
+    d2 = str(tmp_path / "node-1")
+    rec = FlightRecorder(d2, node="1", clock=None)
+    rec.record_commit(0, 0, 0, b"\x01" * 32)
+    rec.record_commit(0, 1, 1, b"\x02" * 32)
+    rec.record_commit(0, 1, 1, b"\x03" * 32)  # same key, new digest
+    rec.close()
+    res, _ = audit.run_audit([d2])
+    assert res.self_conflicts and res.monotonicity_violations
+    assert res.verdict == "fork"
+
+
+def test_status_cross_check(tmp_path):
+    d = str(tmp_path / "node-0")
+    rec = FlightRecorder(d, node="'0'", clock=None)
+    digests = [bytes([i]) * 32 for i in range(4)]
+    for i, dig in enumerate(digests):
+        rec.record_commit(0, i, i, dig)
+    rec.close()
+    res, journals = audit.run_audit([d])
+    doc = {
+        "node": "'0'",
+        "chain_len": 4,
+        "digest_chain": [dig.hex() for dig in digests[2:]],
+        "digest_chain_offset": 2,
+    }
+    audit.cross_check_status(res, doc)
+    assert not res.status_mismatches and res.verdict == "clean"
+    # a live node disagreeing with the journal is a fork
+    bad = dict(doc, digest_chain=["ff" * 32, digests[3].hex()])
+    res2, _ = audit.run_audit([d])
+    audit.cross_check_status(res2, bad)
+    assert res2.status_mismatches and res2.verdict == "fork"

@@ -189,11 +189,30 @@ def test_live_dhb_traffic_roundtrips():
     assert "HbWrap" in kinds
 
 
+def _flight_samples():
+    from hbbft_tpu.obs.flight import (
+        FlightCommit, FlightFault, FlightHello, FlightMsg, FlightNote,
+        FlightSpan,
+    )
+
+    return [
+        FlightHello("3", "runtime", 2, 1, 0.0),
+        FlightMsg(7, 7.0, "in", "2", 0, 3, "ReadyMsg",
+                  wire.encode_message(ReadyMsg(b"\x09" * 32))),
+        FlightMsg(8, 8.0, "out", "all_except:1", 1, 4, "HbWrap", b""),
+        FlightCommit(9, 9.0, 0, 3, 2, b"\xab" * 32),
+        FlightFault(10, 10.0, "1", "MultipleReadys", 0, 3),
+        FlightSpan(11, 11.0, "aba_bval", 0, 3, 2, 1.5, 2.5, 12),
+        FlightSpan(12, 12.0, "epoch", 0, 3, None, 1.0, 3.0, 60),
+        FlightNote(13, 13.0, "replay_gap", "peer=3"),
+    ]
+
+
 def _sample_messages(crypto_bits):
     share, dshare, sig = crypto_bits
     tree = MerkleTree([b"shard-%d" % i for i in range(7)])
     skg = SignedKeyGenMsg(1, 3, "ack", b"\x00\x01\x02", sig)
-    return [
+    return _flight_samples() + [
         ValueMsg(tree.proof(3)),
         EchoMsg(tree.proof(0)),
         ReadyMsg(tree.root_hash()),
@@ -289,7 +308,7 @@ def test_every_registered_type_roundtrips_and_hashes(crypto_bits):
         EpochStarted((3, 11)),
         AlgoMessage(HbWrap(0, SubsetWrap(0, BroadcastWrap(
             0, EchoMsg(tree.proof(1)))))),
-    ]
+    ] + _flight_samples()
     wire.ensure_registered()
     sampled = {type(m) for m in samples}
     registered = set(wire._MSG_TAGS)
