@@ -40,6 +40,7 @@ import json
 import os
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -1112,26 +1113,31 @@ def _net_phase_summary(span_dicts):
     return out
 
 
-def net_cluster_bench(epochs_target: int = 20, n: int = 4,
-                      batch_size: int = 8, tx_size: int = 64):
-    """Localhost 4-node networked QHB benchmark (`--net`).
+def _net_run_once(epochs_target: int, n: int, batch_size: int,
+                  tx_size: int, *, pipeline_depth: int = 1,
+                  encrypt: bool = False, link_delays: str = "",
+                  inflight: Optional[int] = None,
+                  wave_txs: Optional[int] = None,
+                  client_nodes: Optional[int] = None,
+                  slow_node: int = -1, slow_delay_s: float = 0.0,
+                  aba_delay_nodes: str = "", aba_out_delay_s: float = 0.0,
+                  tag: str = "run"):
+    """One localhost cluster measurement: spawn ``n`` node processes,
+    pump client transactions until every node committed ``epochs_target``
+    epochs, fetch every node's ``/spans`` export, tear down.  Returns the
+    raw measurement dict (epochs, wall, latency percentiles, phases,
+    transport stats).
 
-    Spawns ``n`` node processes (``python -m hbbft_tpu.net.cluster``) on a
-    free localhost port range, pumps client transactions through the
-    :mod:`hbbft_tpu.net.client` frontend until every node has committed at
-    least ``epochs_target`` epochs, and reports epochs/sec plus end-to-end
-    p50/p99 submit→commit latency — the networked number "The Latency
-    Price of Threshold Cryptosystems" says to measure.  The baseline for
-    ``vs_baseline`` is the SAME workload on the in-process ``VirtualNet``
-    simulator (tx/s over wall clock): the ratio is the real-socket tax the
-    net stack pays over the crank loop.  Each node also serves its obs
-    endpoint; the JSON line gains a ``phases`` object (per-phase p50/p99 +
-    epoch-latency attribution) built from every node's ``/spans`` export.
-    One JSON line either way, same contract as the config pass.
-    """
+    The submit driver keeps ``max(1, pipeline_depth)`` waves of
+    transactions in flight: at depth 1 this is exactly the serialized
+    submit→wait→repeat loop of the r01/r02 recordings (comparability);
+    deeper pipelines need standing load or the measurement would starve
+    the very concurrency it benchmarks."""
     import asyncio
+    import gc
     import random
     import subprocess
+    from collections import deque
 
     from hbbft_tpu.net.client import latency_percentiles
     from hbbft_tpu.net.cluster import (
@@ -1140,12 +1146,28 @@ def net_cluster_bench(epochs_target: int = 20, n: int = 4,
     )
     from hbbft_tpu.obs.http import http_get
 
+    # same allocation-heavy/cycle-light shape as the nodes (run_node):
+    # stop the driver's gen-0 collector from stealing the shared core
+    gc.set_threshold(50_000, 25, 25)
     base = find_free_base_port(2 * n)
     cfg = ClusterConfig(n=n, seed=9, batch_size=batch_size,
-                        base_port=base, metrics_base_port=base + n)
+                        base_port=base, metrics_base_port=base + n,
+                        encrypt=encrypt, pipeline_depth=pipeline_depth,
+                        link_delays=link_delays, slow_node=slow_node,
+                        slow_delay_s=slow_delay_s,
+                        aba_delay_nodes=aba_delay_nodes,
+                        aba_out_delay_s=aba_out_delay_s)
     procs = {nid: spawn_node(cfg, nid, stdout=subprocess.DEVNULL,
                              stderr=subprocess.STDOUT)
              for nid in range(n)}
+    # driver policy: depth 1 reproduces the r01/r02 serialized
+    # submit→wait→repeat loop exactly; deeper pipelines keep two
+    # half-size waves in flight — enough standing load to feed the
+    # pipeline without drowning the latency measurement in queue wait
+    if inflight is None:
+        inflight = 1 if pipeline_depth <= 1 else 2
+    if wave_txs is None:
+        wave_txs = 4 * batch_size if pipeline_depth <= 1 else 2 * batch_size
 
     async def session():
         clients = [
@@ -1155,28 +1177,65 @@ def net_cluster_bench(epochs_target: int = 20, n: int = 4,
         rng = random.Random(17)
         t0 = time.monotonic()
         wave = 0
-        while True:
+        pending = deque()
+        docs = None
+
+        k = client_nodes or n
+
+        def per_client(txs):
+            # client_nodes < n starves the last node(s) of transactions:
+            # their proposals are empty AND late (they only propose on
+            # seeing epoch activity), racing the Subset give-up threshold
+            # — the honest trigger for split ABA votes and therefore for
+            # genuine threshold-coin rounds (the coin-exercise run)
+            groups = [[] for _ in range(n)]
+            for i, tx in enumerate(txs):
+                groups[i % k].append(tx)
+            return groups
+
+        async def submit_wave():
+            nonlocal wave
             txs = [
-                b"%06d:" % (wave * 100 + i)
-                + bytes(rng.randrange(256) for _ in range(tx_size - 7))
-                for i in range(4 * batch_size)
+                b"%06d:" % (wave * 100 + i) + rng.randbytes(tx_size - 7)
+                for i in range(wave_txs)
             ]
-            # overlap the submits and the commit waits: the benchmark
+            # batched submits, overlapped across clients: the benchmark
             # must measure the cluster, not a serialized submitter
-            await asyncio.gather(*(
-                clients[i % n].submit(tx) for i, tx in enumerate(txs)
+            statuses = await asyncio.gather(*(
+                clients[c].submit_many(group)
+                for c, group in enumerate(per_client(txs))
             ))
-            await asyncio.gather(*(
-                clients[i % n].wait_committed(tx, timeout_s=120)
-                for i, tx in enumerate(txs)
-            ))
+            if any(s != 0 for group in statuses for s in group):
+                raise RuntimeError(f"tx rejected mid-bench: {statuses}")
             wave += 1
-            docs = [await c.status() for c in clients]
-            if min(d["batches"] for d in docs) >= epochs_target:
-                break
-            if wave > 50 * epochs_target:
-                raise RuntimeError("cluster failed to reach epoch target")
+            return txs
+
+        async def await_wave(txs):
+            await asyncio.gather(*(
+                clients[c].wait_committed_many(group, timeout_s=120)
+                for c, group in enumerate(per_client(txs))
+            ))
+
+        while True:
+            while len(pending) < inflight:
+                pending.append(await submit_wave())
+                if wave > 50 * epochs_target:
+                    raise RuntimeError(
+                        "cluster failed to reach epoch target")
+            await await_wave(pending.popleft())
+            # cheap poll: head + batch count only, no digest-chain JSON —
+            # and only every 4th wave: the bench must not tax the very
+            # nodes it measures with a per-wave status_doc + JSON encode
+            if wave % 4 == 0:
+                docs = [await c.status(chain_tail=0) for c in clients]
+                if min(d["batches"] for d in docs) >= epochs_target:
+                    break
+        for txs in pending:  # drain: every submitted tx measured
+            await await_wave(txs)
         wall = time.monotonic() - t0
+        # the full documents (digest chains included) for the
+        # cross-node consistency check, outside the timed window
+        docs = [await c.status() for c in clients]
         # identical batches everywhere — and the chains must actually
         # overlap, or nothing was compared (status_doc truncates chains).
         # Not a bare assert: the check must survive python -O.
@@ -1213,9 +1272,152 @@ def net_cluster_bench(epochs_target: int = 20, n: int = 4,
             span_dicts.extend(
                 json.loads(line) for line in body.splitlines() if line
             )
-        phases = _net_phase_summary(span_dicts)
+        net["phases"] = _net_phase_summary(span_dicts)
     finally:
         shutdown_procs(procs.values())
+    net["pipeline_depth"] = pipeline_depth
+    net["epochs_per_s"] = round(net["epochs"] / net["wall_s"], 3)
+    print(f"# net[{tag}] depth={pipeline_depth} encrypt={encrypt} "
+          f"link_delays={link_delays!r}: {net['epochs_per_s']} epochs/s, "
+          f"p50={net['p50_ms']}ms p99={net['p99_ms']}ms",
+          file=sys.stderr, flush=True)
+    return net
+
+
+def _coin_gauntlet(sessions: int = 8, n: int = 4):
+    """The threshold-coin phase, measured at the protocol's own hard case.
+
+    r02 recorded ``coin: {spans: 0}`` and the satellite assumed a
+    span-finalization bug; measurement (this PR) showed the truth is
+    sharper: **an honest N=4 cluster never reaches the threshold coin at
+    all**.  The Moumen schedule (fixed true/false coins in rounds 0/1)
+    terminates every unanimous ABA before round 2, and Subset's
+    accept/give-up votes are never genuinely split in an honest run —
+    the RBC echo relay equalizes delivery, and the give-up threshold
+    (N−f decided ABAs) is gated by the same message rounds everywhere.
+    Verified empirically: FIFO, random-reorder and full MITM-delay
+    schedules over the QHB stack all produce zero CoinMsgs, while
+    split-input bare ABA — the exact shape of
+    ``tests/binary_agreement_mitm.rs`` — flips the round-2 threshold
+    coin every time.
+
+    So the coin phase is benchmarked where it actually lives: ``sessions``
+    split-input 4-node ABA runs (inputs T,F,T,F — the adversarial input
+    pattern the coin exists to survive), each flipping a genuine
+    BLS-threshold coin (real sign/verify pairings, real shares on the
+    simulated wire).  Spans mirror the SpanTracer semantics: per node,
+    first→last CoinMsg arrival of each coin round.  Returns (durations_s,
+    shares_delivered, rounds).
+    """
+    import random
+
+    from hbbft_tpu.netinfo import NetworkInfo
+    from hbbft_tpu.protocols.binary_agreement import (
+        BinaryAgreement, CoinMsg,
+    )
+    from hbbft_tpu.sim import NetBuilder, NullAdversary
+
+    infos = NetworkInfo.generate_map(list(range(n)), random.Random(9))
+    durations, shares, rounds = [], 0, set()
+    for s in range(sessions):
+        net = NetBuilder(list(range(n))).adversary(
+            NullAdversary()
+        ).crank_limit(500_000).using_step(
+            lambda nid, s=s: BinaryAgreement(
+                infos[nid], b"bench-coin/%d" % s, 0
+            )
+        )
+        for nid in range(n):
+            net.send_input(nid, nid % 2 == 0)
+        agg = {}  # (to, coin_round) -> [t_first, t_last, count]
+        orig_crank = net.crank
+
+        def crank():
+            m = orig_crank()
+            if m is not None:
+                x = m.payload
+                while hasattr(x, "msg") and not isinstance(x, CoinMsg):
+                    x = x.msg
+                if isinstance(x, CoinMsg):
+                    now = time.perf_counter()
+                    a = agg.setdefault((m.to, x.epoch), [now, now, 0])
+                    a[1] = now
+                    a[2] += 1
+            return m
+
+        net.crank = crank
+        net.run_to_quiescence()
+        decisions = {
+            net.nodes[nid].outputs[0]
+            for nid in net.node_ids() if net.nodes[nid].outputs
+        }
+        if len(decisions) != 1:
+            raise RuntimeError(f"coin gauntlet session {s} disagreed: "
+                               f"{decisions}")
+        for (_to, rnd), (t0, t1, cnt) in agg.items():
+            durations.append(t1 - t0)
+            shares += cnt
+            rounds.add(rnd)
+    if not durations:
+        raise RuntimeError("coin gauntlet flipped no threshold coin")
+    return durations, shares, sorted(rounds)
+
+
+def net_cluster_bench(epochs_target: int = 20, n: int = 4,
+                      batch_size: int = 8, tx_size: int = 64,
+                      depths=(1,), crypto_phases: bool = True):
+    """Localhost 4-node networked QHB benchmark (`--net`).
+
+    Sweeps ``--pipeline-depth`` values (each a full cluster run of
+    ``epochs_target`` epochs), reports the BEST depth as the headline
+    epochs/s plus end-to-end p50/p99 submit→commit latency — the
+    networked number "The Latency Price of Threshold Cryptosystems" says
+    to measure.  The baseline for ``vs_baseline`` is the SAME workload on
+    the in-process ``VirtualNet`` simulator (tx/s over wall clock).
+
+    A second measurement (``crypto_phases``) runs the cluster WITH TPKE
+    encryption so the threshold-decrypt phase is genuinely exercised and
+    its span p50/p99 recorded, and fills the coin phase from the
+    :func:`_coin_gauntlet` — the split-input ABA shape that actually
+    reaches the threshold coin (an honest N=4 cluster provably never
+    does; see the gauntlet docstring).  r02 reported ``spans: 0`` for
+    both phases.  One JSON line either way, same contract as the config
+    pass.
+    """
+    import random
+
+    runs = [
+        _net_run_once(epochs_target, n, batch_size, tx_size,
+                      pipeline_depth=depth, tag=f"depth{depth}")
+        for depth in depths
+    ]
+    best = max(runs, key=lambda r: r["epochs_per_s"])
+
+    crypto = None
+    if crypto_phases:
+        crypto = _net_run_once(
+            max(8, epochs_target // 2), n, batch_size, tx_size,
+            pipeline_depth=best["pipeline_depth"], encrypt=True,
+            tag="crypto",
+        )
+        from hbbft_tpu.net.client import percentile
+
+        coin_sessions = 8
+        coin_durs, coin_shares, coin_rounds = _coin_gauntlet(
+            sessions=coin_sessions, n=n)
+        coin_durs.sort()
+        crypto["phases"]["coin"] = {
+            "p50_ms": round(percentile(coin_durs, 0.50) * 1e3, 3),
+            "p99_ms": round(percentile(coin_durs, 0.99) * 1e3, 3),
+            "spans": len(coin_durs),
+            "attr_p50_ms": None,  # not part of the epoch timeline
+            "source": "aba_coin_gauntlet",
+        }
+        crypto["coin_gauntlet"] = {
+            "sessions": coin_sessions,
+            "coin_rounds": coin_rounds,
+            "shares_delivered": coin_shares,
+        }
 
     # -- simulator baseline: identical workload on VirtualNet ----------------
     from hbbft_tpu.netinfo import NetworkInfo
@@ -1241,7 +1443,7 @@ def net_cluster_bench(epochs_target: int = 20, n: int = 4,
     # scales with payload bytes)
     sim_txs = [
         (b"sim-%06d:" % i).ljust(tx_size, b"\x5a")
-        for i in range(net["committed_txs"])
+        for i in range(best["committed_txs"])
     ]
     t0 = time.perf_counter()
     for i, tx in enumerate(sim_txs):
@@ -1252,28 +1454,51 @@ def net_cluster_bench(epochs_target: int = 20, n: int = 4,
         1 for o in sim.nodes[0].outputs if isinstance(o, QhbBatch)
     )
 
-    net_tx_rate = net["committed_txs"] / net["wall_s"]
+    net_tx_rate = best["committed_txs"] / best["wall_s"]
     sim_tx_rate = len(sim_txs) / max(sim_wall, 1e-9)
     line = {
         "metric": f"net_qhb{n}_localhost",
-        "value": round(net["epochs"] / net["wall_s"], 3),
+        "value": best["epochs_per_s"],
         "unit": "epochs/s",
         # real sockets vs the in-process simulator crank loop on the SAME
         # workload: < 1 is the expected price of actual networking
         "vs_baseline": round(net_tx_rate / sim_tx_rate, 3),
         "shape": f"N={n} f={(n - 1) // 3} batch={batch_size} "
-                 f"tx={tx_size}B",
-        "epochs": net["epochs"],
-        "committed_txs": net["committed_txs"],
+                 f"tx={tx_size}B depth={best['pipeline_depth']}",
+        "pipeline_depth": best["pipeline_depth"],
+        "pipeline_sweep": [
+            {
+                "depth": r["pipeline_depth"],
+                "epochs_per_s": r["epochs_per_s"],
+                "tx_per_s": round(r["committed_txs"] / r["wall_s"], 1),
+                "p50_latency_ms": r["p50_ms"],
+                "p99_latency_ms": r["p99_ms"],
+            }
+            for r in runs
+        ],
+        "epochs": best["epochs"],
+        "committed_txs": best["committed_txs"],
         "tx_per_s": round(net_tx_rate, 1),
-        "p50_latency_ms": net["p50_ms"],
-        "p90_latency_ms": net["p90_ms"],
-        "p99_latency_ms": net["p99_ms"],
+        "p50_latency_ms": best["p50_ms"],
+        "p90_latency_ms": best["p90_ms"],
+        "p99_latency_ms": best["p99_ms"],
         "sim_baseline_tx_per_s": round(sim_tx_rate, 1),
         "sim_baseline_epochs": sim_epochs,
-        "phases": phases,
-        "transport": net["transport"],
+        "phases": best["phases"],
+        "transport": best["transport"],
     }
+    if crypto is not None:
+        line["crypto_phases"] = {
+            "shape": f"N={n} f={(n - 1) // 3} batch={batch_size} "
+                     f"tx={tx_size}B depth={crypto['pipeline_depth']} "
+                     f"encrypt=always + coin gauntlet (split-input ABA)",
+            "epochs": crypto["epochs"],
+            "epochs_per_s": crypto["epochs_per_s"],
+            "p50_latency_ms": crypto["p50_ms"],
+            "p99_latency_ms": crypto["p99_ms"],
+            "phases": crypto["phases"],
+            "coin_gauntlet": crypto["coin_gauntlet"],
+        }
     print(json.dumps(line), flush=True)
 
 
@@ -1348,10 +1573,20 @@ def compare_bench(old, new, threshold: float = 0.15,
     add("value", unit.endswith("/s"), threshold)
     for lat in ("p50_latency_ms", "p99_latency_ms"):
         add(lat, False, threshold)
-    add("phases.epoch_wall_p50_ms", False, threshold)
-    add("phases.epoch_wall_p99_ms", False, threshold)
-    for group in ("rbc", "aba", "coin", "decrypt"):
-        add(f"phases.{group}.attr_p50_ms", False, phase_threshold)
+    # Per-EPOCH duration metrics (epoch wall, phase attribution) compare
+    # apples to apples only at equal pipeline depth: with depth > 1,
+    # epochs overlap, so each epoch's first-activity→commit wall
+    # stretches BY DESIGN while throughput and client latency improve.
+    # Across a depth change those metrics measure different quantities —
+    # skip them and let throughput + end-to-end latency (always
+    # comparable) carry the verdict.
+    depths_match = old.get("pipeline_depth", 1) == new.get(
+        "pipeline_depth", 1)
+    if depths_match:
+        add("phases.epoch_wall_p50_ms", False, threshold)
+        add("phases.epoch_wall_p99_ms", False, threshold)
+        for group in ("rbc", "aba", "coin", "decrypt"):
+            add(f"phases.{group}.attr_p50_ms", False, phase_threshold)
     regressions = [c["name"] for c in checks if c["regressed"]]
     return {
         "metric": "bench_compare",
@@ -1359,6 +1594,7 @@ def compare_bench(old, new, threshold: float = 0.15,
         "new_metric": new.get("metric"),
         "ok": not regressions,
         "regressions": regressions,
+        "epoch_metrics_compared": depths_match,
         "checks": checks,
     }
 
@@ -1397,6 +1633,18 @@ def main(argv=None):
              "client tx latency",
     )
     ap.add_argument(
+        "--pipeline-depth", default="1", metavar="D[,D…]",
+        help="--net pipeline depth(s): a comma list runs one full "
+             "measurement per depth (e.g. 1,2,4) and the best depth "
+             "becomes the headline; per-depth results land in "
+             "pipeline_sweep",
+    )
+    ap.add_argument(
+        "--net-no-crypto-phases", action="store_true",
+        help="skip --net's second (encrypted + link-shaped) measurement "
+             "that exercises the threshold coin/decrypt phases",
+    )
+    ap.add_argument(
         "--freeze-baselines", action="store_true",
         help="measure the HOST side of the non-headline configs and "
         "record them in BASELINE_MEASURED.json as the fixed vs_baseline "
@@ -1424,7 +1672,17 @@ def main(argv=None):
         return
 
     if args.net:
-        net_cluster_bench(epochs_target=args.net)
+        try:
+            depths = tuple(
+                int(d) for d in str(args.pipeline_depth).split(",") if d
+            )
+        except ValueError:
+            ap.error(f"--pipeline-depth {args.pipeline_depth!r}: want an "
+                     "int or comma list of ints")
+        net_cluster_bench(
+            epochs_target=args.net, depths=depths or (1,),
+            crypto_phases=not args.net_no_crypto_phases,
+        )
         return
 
     if args.sustained:

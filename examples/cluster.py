@@ -89,6 +89,9 @@ def main() -> None:
     ap.add_argument("--encrypt", action="store_true",
                     help="TPKE-encrypt contributions (EncryptionSchedule "
                          "always instead of never)")
+    ap.add_argument("--pipeline-depth", type=int, default=1,
+                    help="epochs kept in flight per node (1 = sequential; "
+                         "> 1 engages the epoch-pipelined scheduler)")
     args = ap.parse_args()
 
     if args.base_port:
@@ -115,7 +118,7 @@ def main() -> None:
         n=args.nodes, seed=args.seed, base_port=base,
         metrics_base_port=metrics_base,
         batch_size=args.batch_size, encrypt=args.encrypt,
-        flight_dir=flight_dir,
+        flight_dir=flight_dir, pipeline_depth=args.pipeline_depth,
     )
     print(f"spawning {cfg.n} node processes on "
           f"{cfg.host}:{cfg.base_port}..{cfg.base_port + cfg.n - 1}…")

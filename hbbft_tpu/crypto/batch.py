@@ -746,6 +746,155 @@ def bivar_commitment(bivar_poly):
     return BivarCommitment(bivar_poly.degree(), mat)
 
 
+# --------------------------------------------------------------------------
+# Cross-epoch batched share generation / verification (the pump's seam)
+# --------------------------------------------------------------------------
+#
+# The epoch-pipelined node runtime (net/scheduler.py) runs several epochs
+# concurrently, so one pump iteration can carry the threshold-crypto work
+# of many (epoch, proposer) instances at once: ciphertext CCA checks,
+# our own decryption-share generation, and t+1-share set verifications.
+# The entry points below take the WHOLE batch and route it through the
+# best backend — the device MSM ladders above the measured crossover, the
+# native host asm below it — and merge the pairing products so the batch
+# pays ONE shared final exponentiation instead of one per instance.
+# All randomized-linear-combination coefficients are Fiat–Shamir derived
+# (hash of the checked material), so the verdicts are deterministic and
+# the hblint determinism rules hold.
+
+# an MSM fold below this many rows is launch-bound: the native/host mul
+# loop wins (same crossover family as DEVICE_DECRYPT_MIN_BATCH)
+DEVICE_FOLD_MIN_BATCH = 8192
+
+
+def rlc_fold_g1(points, scalars):
+    """``Σ rᵢ·Pᵢ`` over host Jacobian G1 points — the MSM of every RLC
+    verification — device ladder above :data:`DEVICE_FOLD_MIN_BATCH`,
+    per-item host (native asm) muls below it.  Returns a host point or
+    ``None`` for the infinity sum."""
+    if _device_worthwhile(len(points), DEVICE_FOLD_MIN_BATCH):
+        return _CACHE.msm_g1(points, scalars)
+    acc = None
+    for p, s in zip(points, scalars):
+        acc = c.g1_add(acc, c.g1_mul(p, s))
+    return acc
+
+
+def _fs_scalars(seed: bytes, n: int, offset: int = 0):
+    """``n`` deterministic Fiat–Shamir 128-bit coefficients (odd, nonzero)
+    derived from ``seed`` — the randomizers of every merged check here."""
+    import hashlib
+
+    return [
+        int.from_bytes(
+            hashlib.sha3_256(
+                seed + (offset + k).to_bytes(4, "big")
+            ).digest()[:16],
+            "big",
+        )
+        | 1
+        for k in range(n)
+    ]
+
+
+def batch_decrypt_share_gen(secret_scalar: int, cts):
+    """One node's decryption shares ``x_i·U_p`` for many ciphertexts in a
+    single call (same scalar, many bases).  Value-identical to per-item
+    ``SecretKeyShare.decrypt_share(ct, check=False)``; the device ladder
+    engages above the decrypt crossover, the native asm below it."""
+    from hbbft_tpu.crypto import tc
+
+    if not cts:
+        return []
+    if _device_worthwhile(len(cts), DEVICE_DECRYPT_MIN_BATCH):
+        pts = _CACHE.g1_mul_batch(
+            [ct.u for ct in cts], [secret_scalar] * len(cts)
+        )
+        return [tc.DecryptionShare(p) for p in pts]
+    return [
+        tc.DecryptionShare(c.g1_mul(ct.u, secret_scalar)) for ct in cts
+    ]
+
+
+def verify_ciphertext_batch(cts) -> list:
+    """Per-ciphertext CCA verdicts for many TPKE ciphertexts in ONE merged
+    pairing-product check.
+
+    ``e(g1, W_j) == e(U_j, H_j)`` for every j collapses — with FS
+    randomizers ``r_j`` — to ``e(g1, Σ r_j·W_j) · Π e(−r_j·U_j, H_j) == 1``
+    (k+1 pairings instead of 2k, one shared final exponentiation).  On a
+    merged failure each ciphertext is re-checked individually so the
+    verdict list is exactly what per-item ``Ciphertext.verify()`` returns.
+    """
+    import hashlib
+
+    from hbbft_tpu.crypto import tc
+
+    if not cts:
+        return []
+    if len(cts) == 1:
+        return [cts[0].verify()]
+    seed = hashlib.sha3_256(
+        b"HBBFT-CT-BATCH" + b"".join(ct.to_bytes() for ct in cts)
+    ).digest()
+    rs = _fs_scalars(seed, len(cts))
+    hs = [tc._hash_ciphertext_point(ct.u, ct.v) for ct in cts]
+    w_acc = None
+    pairs = []
+    for ct, h, r in zip(cts, hs, rs):
+        w_acc = c.g2_add(w_acc, c.g2_mul(ct.w, r))
+        pairs.append((c.g1_neg(c.g1_mul(ct.u, r)), h))
+    pairs.append((c.G1_GEN, w_acc))
+    if c.pairing_check(pairs):
+        return [True] * len(cts)
+    return [ct.verify() for ct in cts]
+
+
+def verify_dec_share_sets(jobs) -> list:
+    """Merged verification of many t+1 decryption-share sets — the
+    cross-epoch batched call the pipelined pump issues once per iteration.
+
+    ``jobs``: ``(pks, items, ct)`` triples where ``items`` is the
+    ``(share_index, DecryptionShare)`` list of one (epoch, proposer)
+    instance and ``ct`` its ciphertext.  Each job's own check is the
+    Fiat–Shamir RLC of :meth:`ThresholdDecrypt._batch_verify`; the jobs
+    merge into ONE pairing-product check (2k pairings, one shared final
+    exponentiation — the ``pc8`` regime of the host pairing is ~2.5×
+    cheaper than k separate 2-pairing checks).  On a merged failure each
+    job is isolated with its own check, so the returned verdict list
+    matches the per-job ground truth."""
+    import hashlib
+
+    from hbbft_tpu.crypto import tc
+
+    if not jobs:
+        return []
+    seed = hashlib.sha3_256(
+        b"HBBFT-TD-MULTI"
+        + b"".join(
+            ct.to_bytes() + b"".join(s.to_bytes() for _, s in items)
+            for _pks, items, ct in jobs
+        )
+    ).digest()
+    pairs = []
+    per_job = []
+    for j, (pks, items, ct) in enumerate(jobs):
+        h = tc._hash_ciphertext_point(ct.u, ct.v)
+        rhos = _fs_scalars(seed, len(items), offset=j * 4096)
+        acc_share = rlc_fold_g1([s.point for _, s in items], rhos)
+        acc_pk = rlc_fold_g1(
+            [pks.public_key_share(i).point for i, _ in items], rhos
+        )
+        job_pairs = [(c.g1_neg(acc_share), h), (acc_pk, ct.w)]
+        per_job.append(job_pairs)
+        pairs.extend(job_pairs)
+    if len(jobs) == 1 or c.pairing_check(pairs):
+        if len(jobs) == 1:
+            return [c.pairing_check(per_job[0])]
+        return [True] * len(jobs)
+    return [c.pairing_check(jp) for jp in per_job]
+
+
 def batch_verify_sig_shares(
     pairs: Sequence[Tuple[object, object]],
     msg: bytes,

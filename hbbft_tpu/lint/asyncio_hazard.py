@@ -23,6 +23,12 @@ loses a task, or blocks the loop stalls consensus for the whole node.
   reading wedges the awaiting task *while it holds the lock*, starving
   every other task that needs it — the deadlock shape the transport's
   heartbeat logic documents.
+- ``pump-inline-crypto`` — a direct ``pairing*`` / share-verify /
+  share-generation call in the scheduler module (``net/scheduler.py``).
+  The pump's contract is that ALL threshold crypto flows through the
+  protocols' deferred-resolution surface and ``crypto/batch.py``'s
+  batched executor path; a direct call in the scheduler bypasses the
+  cross-epoch batching (and, on the event-loop side, blocks the loop).
 """
 
 from __future__ import annotations
@@ -50,6 +56,23 @@ _NET_IO_ATTRS = {
     "open_connection", "sendall", "recv", "connect", "accept",
     "wait_closed", "start_server",
 }
+
+#: call names that ARE threshold crypto — banned outright in the
+#: scheduler module (see the ``pump-inline-crypto`` rule)
+_PUMP_CRYPTO_NAMES = {
+    "pairing", "pairing_check", "miller_loop",
+    "verify", "verify_signature", "verify_signature_share",
+    "verify_decryption_share", "batch_verify_sig_shares",
+    "batch_verify_dec_shares", "verify_dec_share_sets",
+    "verify_ciphertext_batch", "decrypt_share", "decrypt",
+    "combine_signatures", "sign", "encrypt",
+}
+
+def _is_pump_module(path: str) -> bool:
+    """The pump-inline-crypto rule's scope: scheduler modules of the net
+    layer (``hbbft_tpu/net/scheduler.py`` and any sibling scheduler)."""
+    base = path.rsplit("/", 1)[-1]
+    return "/net/" in f"/{path}" and "scheduler" in base
 
 
 def _lock_like(expr: ast.AST) -> Optional[str]:
@@ -109,6 +132,10 @@ class AsyncioHazardChecker(Checker):
         "async-lock-across-await":
             "lock held across an await of network I/O — a stalled peer "
             "wedges every task contending for the lock",
+        "pump-inline-crypto":
+            "direct pairing/share-crypto call in the scheduler module — "
+            "threshold crypto must flow through the protocols' deferred "
+            "resolution and crypto/batch.py's batched executor path",
     }
 
     def check_module(self, mod: ModuleSource) -> Iterable[Finding]:
@@ -117,6 +144,7 @@ class AsyncioHazardChecker(Checker):
             return []
         out: List[Finding] = []
         async_defs = _collect_async_defs(tree)
+        pump_module = _is_pump_module(mod.path)
         for node in ast.walk(tree):
             if isinstance(node, ast.Expr) and isinstance(
                 node.value, ast.Call
@@ -126,7 +154,27 @@ class AsyncioHazardChecker(Checker):
                 self._check_async_body(mod, node, out)
             if isinstance(node, (ast.AsyncWith, ast.With)):
                 self._check_lock_span(mod, node, out)
+            if pump_module and isinstance(node, ast.Call):
+                self._check_pump_crypto(mod, node, out)
         return out
+
+    # -- direct crypto calls in the scheduler -------------------------------
+
+    def _check_pump_crypto(self, mod, call: ast.Call, out) -> None:
+        func = call.func
+        name = None
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute):
+            name = func.attr
+        if name in _PUMP_CRYPTO_NAMES:
+            out.append(self.finding(
+                mod, "pump-inline-crypto", call,
+                f"{name}() called directly in the scheduler: route it "
+                f"through the protocols' resolve_deferred surface / "
+                f"crypto.batch so it joins the per-iteration batched "
+                f"call (and never runs on the event loop)",
+            ))
 
     # -- bare expression statements ----------------------------------------
 

@@ -19,11 +19,12 @@ import contextlib
 import hashlib
 import json
 import struct
+import threading
 import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
-from hbbft_tpu.net import framing
+from hbbft_tpu.net import framing, transport
 from hbbft_tpu.net.framing import (
     DEFAULT_MAX_FRAME,
     FrameDecoder,
@@ -91,6 +92,10 @@ class Mempool:
         self.pending_bytes = 0
         self._pending: "OrderedDict[bytes, bytes]" = OrderedDict()  # digest→tx
         self._seen: "OrderedDict[bytes, None]" = OrderedDict()  # recent commits
+        # admission (event loop) and commit pruning (the runtime's pump
+        # worker) run on different threads since the pipelined scheduler;
+        # the compound size/byte-budget invariants need this lock
+        self._lock = threading.Lock()
         self._acks = None
         if registry is not None:
             self.bind_registry(registry)
@@ -125,27 +130,29 @@ class Mempool:
         if len(tx) > self.max_tx_bytes:
             return self._count(self.REJECTED)
         digest = tx_digest(tx)
-        if digest in self._pending or digest in self._seen:
-            return self._count(self.DUPLICATE)
-        if (len(self._pending) >= self.capacity
-                or self.pending_bytes + len(tx) > self.max_pending_bytes):
-            return self._count(self.FULL)
-        self._pending[digest] = tx
-        self.pending_bytes += len(tx)
+        with self._lock:
+            if digest in self._pending or digest in self._seen:
+                return self._count(self.DUPLICATE)
+            if (len(self._pending) >= self.capacity
+                    or self.pending_bytes + len(tx) > self.max_pending_bytes):
+                return self._count(self.FULL)
+            self._pending[digest] = tx
+            self.pending_bytes += len(tx)
         return self._count(self.ACCEPTED)
 
     def mark_committed(self, txs) -> List[bytes]:
         """Drop committed txs from pending; returns their digests."""
         digests = []
-        for tx in txs:
-            digest = tx_digest(tx)
-            digests.append(digest)
-            dropped = self._pending.pop(digest, None)
-            if dropped is not None:
-                self.pending_bytes -= len(dropped)
-            self._seen[digest] = None
-        while len(self._seen) > self.seen_cap:
-            self._seen.popitem(last=False)
+        with self._lock:
+            for tx in txs:
+                digest = tx_digest(tx)
+                digests.append(digest)
+                dropped = self._pending.pop(digest, None)
+                if dropped is not None:
+                    self.pending_bytes -= len(dropped)
+                self._seen[digest] = None
+            while len(self._seen) > self.seen_cap:
+                self._seen.popitem(last=False)
         return digests
 
     def __len__(self) -> int:
@@ -176,7 +183,11 @@ class ClusterClient:
         self._wlock = asyncio.Lock()
         self._reader_task: Optional[asyncio.Task] = None
         self._keepalive_task: Optional[asyncio.Task] = None
-        self._acks: Dict[bytes, asyncio.Future] = {}
+        # per-digest FIFO waiter lists, like _commits: a duplicate digest
+        # in one batch (or a submit racing submit_many) must not clobber
+        # an earlier future — each TX frame written earns one ack, and
+        # acks resolve waiters in submission order
+        self._acks: Dict[bytes, List[asyncio.Future]] = {}
         # one future PER WAITER (asyncio.wait_for cancels the future it
         # wraps, so sharing one would let a timed-out waiter break the
         # others and leave a dead future pinned under the digest)
@@ -199,6 +210,7 @@ class ClusterClient:
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(*self.addr), self.connect_timeout_s
         )
+        transport.set_nodelay(writer)
         self._reader, self._writer = reader, writer
         hello = Hello(node_id=self.client_id, role=ROLE_CLIENT,
                       cluster_id=self.cluster_id, era=0, epoch=0)
@@ -248,7 +260,7 @@ class ClusterClient:
             for _attempt in range(max_retries):
                 self._check_alive()
                 fut = asyncio.get_running_loop().create_future()
-                self._acks[digest] = fut
+                self._acks.setdefault(digest, []).append(fut)
                 self._submit_times.setdefault(digest, time.monotonic())
                 async with self._wlock:
                     self._writer.write(framing.encode_frame(
@@ -258,7 +270,7 @@ class ClusterClient:
                 try:
                     status = await asyncio.wait_for(fut, ack_timeout_s)
                 finally:
-                    self._acks.pop(digest, None)  # timed-out ack entries
+                    self._drop_ack_waiter(digest, fut)
                 if status != framing.ACK_FULL or not retry_full:
                     return status
                 await asyncio.sleep(delay)
@@ -272,6 +284,36 @@ class ClusterClient:
                 status == framing.ACK_DUPLICATE and digest in self._committed
             ):
                 self._submit_times.pop(digest, None)
+
+    async def submit_many(self, txs, *, ack_timeout_s: float = 30.0) -> list:
+        """Submit a batch of transactions with ONE socket write and one
+        shared ack wait — the load-generator fast path (a per-tx
+        ``submit()`` loop costs a lock round + drain + timer per
+        transaction, which on a small host is a measurable share of the
+        cluster's CPU).  No FULL-retry logic: callers that batch are
+        expected to size waves under the mempool bound.  Returns the ack
+        status list, index-aligned with ``txs``."""
+        self._check_alive()
+        loop = asyncio.get_running_loop()
+        futs = []
+        buf = bytearray()
+        for tx in txs:
+            digest = tx_digest(tx)
+            fut = loop.create_future()
+            self._acks.setdefault(digest, []).append(fut)
+            futs.append((digest, fut))
+            self._submit_times.setdefault(digest, time.monotonic())
+            buf += framing.encode_frame(framing.TX, tx, self.max_frame)
+        async with self._wlock:
+            self._writer.write(bytes(buf))
+            await self._writer.drain()
+        try:
+            return list(await asyncio.wait_for(
+                asyncio.gather(*(f for _d, f in futs)), ack_timeout_s
+            ))
+        finally:
+            for digest, fut in futs:
+                self._drop_ack_waiter(digest, fut)
 
     async def wait_committed(self, tx: bytes, timeout_s: float = 60.0) -> float:
         """Block until the node reports ``tx`` committed; returns the
@@ -293,13 +335,51 @@ class ClusterClient:
             if not waiters:
                 self._commits.pop(digest, None)
 
-    async def status(self, timeout_s: float = 10.0) -> dict:
+    async def wait_committed_many(self, txs, timeout_s: float = 60.0) -> list:
+        """Latencies for a batch of transactions with one shared timeout
+        (a ``wait_committed`` per tx costs a timer handle + future wrap
+        each).  Returns latency seconds, index-aligned with ``txs``."""
+        loop = asyncio.get_running_loop()
+        futs = []
+        waiter_refs = []
+        for tx in txs:
+            digest = tx_digest(tx)
+            done = self._committed.get(digest)
+            if done is not None:
+                fut = loop.create_future()
+                fut.set_result(done)
+                futs.append(fut)
+                continue
+            self._check_alive()
+            fut = loop.create_future()
+            waiters = self._commits.setdefault(digest, [])
+            waiters.append(fut)
+            waiter_refs.append((digest, waiters, fut))
+            futs.append(fut)
+        try:
+            return list(await asyncio.wait_for(
+                asyncio.gather(*futs), timeout_s
+            ))
+        finally:
+            for digest, waiters, fut in waiter_refs:
+                if fut in waiters:
+                    waiters.remove(fut)
+                if not waiters:
+                    self._commits.pop(digest, None)
+
+    async def status(self, timeout_s: float = 10.0,
+                     chain_tail: Optional[int] = None) -> dict:
+        """Fetch the node's status document.  ``chain_tail`` limits the
+        digest-chain tail in the reply (0 = head/length only — the cheap
+        form for poll loops; None = the node's full default)."""
         self._check_alive()
         fut = asyncio.get_running_loop().create_future()
         self._status_waiters.append(fut)
+        payload = b"" if chain_tail is None else struct.pack(
+            ">I", chain_tail)
         async with self._wlock:
             self._writer.write(framing.encode_frame(
-                framing.STATUS_REQ, b"", self.max_frame
+                framing.STATUS_REQ, payload, self.max_frame
             ))
             await self._writer.drain()
         return await asyncio.wait_for(fut, timeout_s)
@@ -348,12 +428,24 @@ class ClusterClient:
                 else ConnectionError(f"client receive loop died: {exc!r}")
             )
 
+    def _drop_ack_waiter(self, digest: bytes, fut: asyncio.Future) -> None:
+        waiters = self._acks.get(digest)
+        if waiters is not None:
+            with contextlib.suppress(ValueError):
+                waiters.remove(fut)
+            if not waiters:
+                del self._acks[digest]
+
     def _on_frame(self, kind: int, payload: bytes) -> None:
         if kind == framing.TX_ACK:
             status, digest = payload[0], payload[1:33]
-            fut = self._acks.pop(digest, None)
-            if fut is not None and not fut.done():
-                fut.set_result(status)
+            waiters = self._acks.get(digest)
+            if waiters:
+                fut = waiters.pop(0)  # one ack per written TX frame: FIFO
+                if not waiters:
+                    del self._acks[digest]
+                if not fut.done():
+                    fut.set_result(status)
         elif kind == framing.TX_COMMIT:
             # u64 era + u64 epoch + u32 count + count × 32-byte digests;
             # nodes broadcast every committed digest to every client, so
@@ -393,7 +485,9 @@ class ClusterClient:
         commit_futs = [
             fut for waiters in self._commits.values() for fut in waiters
         ]
-        for fut in (list(self._acks.values()) + commit_futs
-                    + self._status_waiters):
+        ack_futs = [
+            fut for waiters in self._acks.values() for fut in waiters
+        ]
+        for fut in (ack_futs + commit_futs + self._status_waiters):
             if not fut.done():
                 fut.set_exception(exc)

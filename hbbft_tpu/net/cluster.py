@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import os
 import random
 import signal
@@ -66,6 +67,74 @@ class ClusterConfig:
     flight_dir: str = ""
     flight_max_segment_bytes: int = 4 * 2**20
     flight_max_segments: int = 16
+    # epochs kept in flight per node (net/scheduler.py): 1 = sequential
+    # (today's behavior), N = epoch e+N-1's RBC/ABA may start while epoch
+    # e still threshold-decrypts
+    pipeline_depth: int = 1
+    # outbound link shaping, "SRC>DST:SECONDS,…" (e.g. "3>0:0.02,3>1:0.02"
+    # delays node 3's frames to nodes 0 and 1 by 20 ms); "" → no shaping
+    link_delays: str = ""
+    # slow-node shaping: node `slow_node` sleeps `slow_delay_s` before
+    # every pump iteration (an overloaded validator) — the bench's
+    # coin-exercise knob; -1 → nobody is slowed
+    slow_node: int = -1
+    slow_delay_s: float = 0.0
+    # general form: per-node pump delays "NID:SECONDS,…" (e.g.
+    # "0:0.04,3:0.02") — a heterogeneous cluster where every validator
+    # runs at its own speed; entries here override slow_node/slow_delay_s
+    step_delays: str = ""
+    # class-selective shaping: the listed nodes ("0,1") hold their
+    # outbound BINARY-AGREEMENT traffic (BVal/Aux/Conf/Coin/Term) for
+    # `aba_out_delay_s` while RBC flows normally.  Decorrelating ABA
+    # progress from RBC delivery is what genuinely splits Subset's
+    # accept/give-up votes (plain per-link delay cannot: the RBC echo
+    # relay re-equalizes deliveries) — the honest trigger for real
+    # threshold-coin rounds.  "" → nobody shaped.
+    aba_delay_nodes: str = ""
+    aba_out_delay_s: float = 0.0
+    # narrow the hold to specific phase classes (comma list of span
+    # names, e.g. "aba_conf"); "" → every aba_* class
+    aba_out_classes: str = ""
+
+    def link_delays_for(self, nid: int) -> Dict[int, float]:
+        """This node's outbound per-peer delays parsed from link_delays."""
+        out: Dict[int, float] = {}
+        if not self.link_delays:
+            return out
+        for entry in self.link_delays.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            path, _, secs = entry.partition(":")
+            src, _, dst = path.partition(">")
+            if not secs or not dst:
+                raise ValueError(f"bad link_delays entry {entry!r} "
+                                 "(want SRC>DST:SECONDS)")
+            if int(src) == nid:
+                out[int(dst)] = float(secs)
+        return out
+
+    def step_delay_for(self, nid: int) -> float:
+        """This node's pump delay: step_delays map, else slow_node."""
+        if self.step_delays:
+            for entry in self.step_delays.split(","):
+                entry = entry.strip()
+                if not entry:
+                    continue
+                node, _, secs = entry.partition(":")
+                if not secs:
+                    raise ValueError(f"bad step_delays entry {entry!r} "
+                                     "(want NID:SECONDS)")
+                if int(node) == nid:
+                    return float(secs)
+        return self.slow_delay_s if nid == self.slow_node else 0.0
+
+    def aba_delay_for(self, nid: int) -> float:
+        """This node's outbound ABA-class hold, from aba_delay_nodes."""
+        if not self.aba_delay_nodes or self.aba_out_delay_s <= 0:
+            return 0.0
+        shaped = {int(x) for x in self.aba_delay_nodes.split(",") if x}
+        return self.aba_out_delay_s if nid in shaped else 0.0
 
     @property
     def cluster_id(self) -> bytes:
@@ -130,6 +199,11 @@ def build_runtime(cfg: ClusterConfig, infos: Dict[int, NetworkInfo],
         flight_dir=cfg.node_flight_dir(nid),
         flight_max_segment_bytes=cfg.flight_max_segment_bytes,
         flight_max_segments=cfg.flight_max_segments,
+        pipeline_depth=cfg.pipeline_depth,
+        link_delays=cfg.link_delays_for(nid),
+        step_delay_s=cfg.step_delay_for(nid),
+        aba_out_delay_s=cfg.aba_delay_for(nid),
+        aba_out_classes=cfg.aba_out_classes,
         **kwargs,
     )
 
@@ -267,6 +341,16 @@ def node_command(cfg: ClusterConfig, nid: int) -> List[str]:
         cmd += ["--flight-dir", cfg.flight_dir]
     if cfg.encrypt:
         cmd.append("--encrypt")
+    if cfg.pipeline_depth != 1:
+        cmd += ["--pipeline-depth", str(cfg.pipeline_depth)]
+    if cfg.link_delays:
+        cmd += ["--link-delays", cfg.link_delays]
+    if cfg.step_delay_for(nid) > 0:
+        cmd += ["--step-delay", str(cfg.step_delay_for(nid))]
+    if cfg.aba_delay_for(nid) > 0:
+        cmd += ["--aba-out-delay", str(cfg.aba_out_delay_s)]
+        if cfg.aba_out_classes:
+            cmd += ["--aba-out-classes", cfg.aba_out_classes]
     return cmd
 
 
@@ -320,7 +404,31 @@ def shutdown_procs(procs, timeout_s: float = 15.0) -> None:
 
 async def run_node(cfg: ClusterConfig, nid: int,
                    metrics_port: int = 0) -> None:
-    """Run one node forever (the subprocess entry body)."""
+    """Run one node forever (the subprocess entry body).
+
+    ``HBBFT_NODE_PROFILE=<dir>`` cProfiles the whole node process and
+    dumps pstats to ``<dir>/node-<id>.pstats`` on clean shutdown — the
+    only way to see where a REAL (multi-process, socket-driven) node
+    spends CPU, since in-process profiles skew the event-loop/syscall
+    mix.
+    """
+    # The consensus hot path allocates heavily (Steps, frozen message
+    # dataclasses, frames) but makes almost no reference cycles; the
+    # default gen-0 threshold (700) makes the collector scan thousands of
+    # times per second for nothing.  Raise the thresholds rather than
+    # disable: asyncio does create cycles (Task exception contexts), so
+    # collection must still happen, just orders of magnitude less often.
+    import gc
+    gc.set_threshold(50_000, 25, 25)
+    profile_dir = os.environ.get("HBBFT_NODE_PROFILE", "")
+    profiler = None
+    if profile_dir:
+        import cProfile
+        # CPU-time timer: with several node processes sharing cores, the
+        # default wall timer books preemption gaps onto whatever call was
+        # live, swamping the real hot spots
+        profiler = cProfile.Profile(time.process_time)
+        profiler.enable()
     infos = generate_infos(cfg)
     rt = build_runtime(cfg, infos, nid)
     try:
@@ -336,12 +444,38 @@ async def run_node(cfg: ClusterConfig, nid: int,
         for sig in (signal.SIGTERM, signal.SIGINT):
             loop.add_signal_handler(sig, stop.set)
         print(f"node {nid} listening on {host}:{port}", flush=True)
-        await stop.wait()
+        # a dead step pump is a dead node: surface its exception instead
+        # of serving sockets for a consensus engine that no longer runs
+        stop_task = asyncio.ensure_future(stop.wait())
+        done, _pending = await asyncio.wait(
+            {stop_task, rt.pump.task}, return_when=asyncio.FIRST_COMPLETED
+        )
+        if rt.pump.task in done:
+            stop_task.cancel()
+            exc = rt.pump.task.exception()
+            if exc is not None:
+                raise exc
     except BaseException as exc:
         # crash-dump flush: make the black box land on disk before the
         # process dies, whatever killed it
         rt.flight_crash(exc)
         raise
+    finally:
+        if profiler is not None:
+            profiler.disable()
+            os.makedirs(profile_dir, exist_ok=True)
+            profiler.dump_stats(
+                os.path.join(profile_dir, f"node-{nid}.pstats"))
+        timing_dir = os.environ.get("HBBFT_PUMP_TIMING", "")
+        if timing_dir and rt._pump_timing:
+            os.makedirs(timing_dir, exist_ok=True)
+            # hblint: disable=async-blocking-call (one-shot perf-diagnosis
+            # dump on the shutdown path; nothing is being served anymore)
+            with open(os.path.join(timing_dir, f"node-{nid}.json"),
+                      "w") as fh:
+                json.dump({"timing": rt._pump_timing,
+                           "batches": len(rt.batches),
+                           "iterations": rt.pump.iterations}, fh)
     await rt.stop()
 
 
@@ -362,13 +496,34 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--flight-dir", default="",
                     help="flight-recorder journal ROOT (this node "
                          "journals to <dir>/node-<id>; empty = off)")
+    ap.add_argument("--pipeline-depth", type=int, default=1,
+                    help="epochs kept in flight at once (1 = sequential)")
+    ap.add_argument("--link-delays", default="",
+                    help="outbound link shaping, SRC>DST:SECONDS[,…] "
+                         "(only entries whose SRC is this node apply)")
+    ap.add_argument("--step-delay", type=float, default=0.0,
+                    help="sleep SECONDS before every pump iteration "
+                         "(slow-node chaos shaping)")
+    ap.add_argument("--aba-out-delay", type=float, default=0.0,
+                    help="hold THIS node's outbound binary-agreement "
+                         "traffic for SECONDS (class-selective shaping)")
+    ap.add_argument("--aba-out-classes", default="",
+                    help="narrow --aba-out-delay to these phase classes "
+                         "(comma list, e.g. aba_conf); empty = all aba_*")
     args = ap.parse_args(argv)
     if not 0 <= args.node_id < args.nodes:
         ap.error(f"--node-id {args.node_id} not in 0..{args.nodes - 1}")
     cfg = ClusterConfig(
         n=args.nodes, seed=args.seed, base_port=args.base_port,
         batch_size=args.batch_size, encrypt=args.encrypt,
-        flight_dir=args.flight_dir,
+        flight_dir=args.flight_dir, pipeline_depth=args.pipeline_depth,
+        link_delays=args.link_delays,
+        slow_node=(args.node_id if args.step_delay > 0 else -1),
+        slow_delay_s=args.step_delay,
+        aba_delay_nodes=(str(args.node_id) if args.aba_out_delay > 0
+                         else ""),
+        aba_out_delay_s=args.aba_out_delay,
+        aba_out_classes=args.aba_out_classes,
     )
     asyncio.run(run_node(cfg, args.node_id,
                          metrics_port=args.metrics_port))

@@ -24,7 +24,10 @@ from typing import Hashable, List, Tuple
 from hbbft_tpu.protocols import wire
 
 MAGIC = b"HBTN"
-PROTOCOL_VERSION = 1
+# v2: MSG_BATCH coalesced consensus frames (epoch-pipelined runtime).
+# The hello's version check turns a mixed-version cluster into a clean
+# handshake error instead of mid-stream frame-kind surprises.
+PROTOCOL_VERSION = 2
 
 # Frame cap: one frame carries at most one wire message (itself capped at
 # wire.MAX_MESSAGE_BYTES) plus the kind byte; the hello/control frames are
@@ -42,11 +45,12 @@ TX_ACK = 0x06      # node → client: u8 status + 32-byte tx digest
 TX_COMMIT = 0x07   # node → client: era/epoch + committed tx digests
 STATUS_REQ = 0x08  # client → node: empty
 STATUS = 0x09      # node → client: JSON status document
+MSG_BATCH = 0x0A   # several MSG payloads coalesced into one frame
 
 KIND_NAMES = {
     HELLO: "HELLO", MSG: "MSG", PING: "PING", PONG: "PONG", TX: "TX",
     TX_ACK: "TX_ACK", TX_COMMIT: "TX_COMMIT", STATUS_REQ: "STATUS_REQ",
-    STATUS: "STATUS",
+    STATUS: "STATUS", MSG_BATCH: "MSG_BATCH",
 }
 
 # TX_ACK status bytes
@@ -106,6 +110,68 @@ class FrameDecoder:
     def pending(self) -> int:
         """Bytes buffered awaiting a complete frame."""
         return len(self._buf)
+
+
+def pack_msgs(payloads: List[bytes],
+              max_frame: int = DEFAULT_MAX_FRAME) -> List[bytes]:
+    """Coalesce consensus message payloads into as few frames as the cap
+    allows: one plain :data:`MSG` frame for a lone payload, otherwise
+    :data:`MSG_BATCH` frames whose body is ``(u32 len | payload)*``.
+
+    This is the per-(pump-iteration, destination) write path of the
+    epoch-pipelined runtime — it turns dozens of per-message socket
+    writes into one or two — and it is order-preserving.  A payload that
+    cannot fit even alone raises :class:`FrameError` (callers pre-check
+    against the cap and drop loudly)."""
+    frames: List[bytes] = []
+    group: List[bytes] = []
+    size = 1  # kind byte
+
+    def flush() -> None:
+        if not group:
+            return
+        if len(group) == 1:
+            frames.append(encode_frame(MSG, group[0], max_frame))
+        else:
+            body = b"".join(
+                struct.pack(">I", len(p)) + p for p in group
+            )
+            frames.append(encode_frame(MSG_BATCH, body, max_frame))
+        group.clear()
+
+    for p in payloads:
+        if 1 + len(p) > max_frame:
+            raise FrameError(
+                f"message of {len(p)} bytes exceeds frame cap {max_frame}"
+            )
+        if group and size + 4 + len(p) > max_frame:
+            flush()
+            size = 1
+        group.append(p)
+        size += 4 + len(p)
+    flush()
+    return frames
+
+
+def split_msgs(payload: bytes) -> List[bytes]:
+    """Inverse of the :data:`MSG_BATCH` body encoding; truncation or
+    trailing garbage is a loud :class:`FrameError` (the sender is
+    malformed, not merely slow)."""
+    out: List[bytes] = []
+    off = 0
+    n = len(payload)
+    while off < n:
+        if off + 4 > n:
+            raise FrameError("truncated MSG_BATCH length prefix")
+        (length,) = struct.unpack_from(">I", payload, off)
+        off += 4
+        if off + length > n:
+            raise FrameError("truncated MSG_BATCH entry")
+        out.append(payload[off : off + length])
+        off += length
+    if not out:
+        raise FrameError("empty MSG_BATCH frame")
+    return out
 
 
 async def read_one_frame(reader, max_frame: int = DEFAULT_MAX_FRAME

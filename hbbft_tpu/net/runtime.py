@@ -7,6 +7,14 @@ contract demands: it feeds received wire bytes into a
 ``Target::All/AllExcept/Node`` against the transport's peer set, and
 encodes every message exactly once per payload.
 
+Since the epoch-pipelined scheduler landed, the protocol no longer runs
+inside transport callbacks: every event is queued on a
+:class:`~hbbft_tpu.net.scheduler.StepPump`, whose worker thread runs the
+state machine (threshold crypto included) off the event loop, keeps up to
+``pipeline_depth`` epochs in flight, resolves cross-epoch batched share
+verifications once per iteration, and coalesces each iteration's
+outbound messages into per-peer MSG_BATCH frames.
+
 Catch-up (the ``EpochStarted`` path):
 
 - every connection hello carries the sender's current (era, epoch);
@@ -30,24 +38,34 @@ makes cross-node batch-identity a one-line comparison.
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import json
 import logging
+import os
 import struct
 import time
 from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
 
 from hbbft_tpu.net import framing
 from hbbft_tpu.net.client import Mempool, tx_digest
+from hbbft_tpu.net.scheduler import StepPump
 from hbbft_tpu.net.transport import ClientConn, Transport
 from hbbft_tpu.obs.flight import FlightObserver, FlightRecorder
 from hbbft_tpu.obs.http import ObsServer
 from hbbft_tpu.obs.metrics import MetricAttr, Registry, fault_counter
 from hbbft_tpu.obs.spans import SpanTracer
 from hbbft_tpu.protocols import wire
-from hbbft_tpu.protocols.dynamic_honey_badger import DhbBatch
-from hbbft_tpu.protocols.honey_badger import Batch as HbBatch
-from hbbft_tpu.protocols.queueing_honey_badger import QhbBatch, TxInput
+from hbbft_tpu.protocols.dynamic_honey_badger import (
+    DhbBatch,
+    DynamicHoneyBadger,
+)
+from hbbft_tpu.protocols.honey_badger import Batch as HbBatch, HoneyBadger
+from hbbft_tpu.protocols.queueing_honey_badger import (
+    PipelineInput,
+    QhbBatch,
+    TxInput,
+)
 from hbbft_tpu.protocols.sender_queue import (
     AlgoMessage,
     EpochStarted,
@@ -57,6 +75,26 @@ from hbbft_tpu.protocols.sender_queue import (
     message_key,
 )
 from hbbft_tpu.traits import Step
+
+
+class _PumpOutcome:
+    """One pump iteration's deferred side effects, applied on the event
+    loop after the worker thread returns: coalesced outbound payloads per
+    destination (insertion-ordered) and client commit notifications."""
+
+    __slots__ = ("frames", "frames_delayed", "commits", "cpu_s")
+
+    def __init__(self):
+        self.frames: Dict[NodeId, List[bytes]] = {}
+        # payloads held back by class-selective shaping (pump_flush
+        # schedules them `aba_out_delay_s` later, out of band so they
+        # never head-block the fast classes)
+        self.frames_delayed: Dict[NodeId, List[bytes]] = {}
+        self.commits: List[Tuple[int, int, List[bytes]]] = []
+        # CPU seconds this iteration actually burned (thread time, immune
+        # to preemption on a contended host) — drives the pump's
+        # inline-vs-executor decision
+        self.cpu_s: float = 0.0
 
 NodeId = Hashable
 EpochKey = Tuple[int, int]
@@ -86,9 +124,46 @@ class NodeRuntime:
         flight_dir: Optional[str] = None,
         flight_max_segment_bytes: int = 4 * 2**20,
         flight_max_segments: int = 16,
+        pipeline_depth: int = 1,
+        step_delay_s: float = 0.0,
+        aba_out_delay_s: float = 0.0,
+        aba_out_classes: str = "",
         **transport_kwargs,
     ):
         self.sq = algo if isinstance(algo, SenderQueue) else SenderQueue(algo)
+        # Epoch-pipelined scheduler (net/scheduler.py): every protocol
+        # interaction is queued and processed in batches on the pump's
+        # worker thread; with pipeline_depth > 1 the pump keeps that many
+        # epochs proposed-into at once.  Depth 1 preserves the sequential
+        # one-epoch-at-a-time behavior.
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        # chaos/scenario knob: sleep this long before every pump
+        # iteration — models an overloaded/underprovisioned validator
+        # (the bench's coin-exercise run slows one node until its own
+        # proposal races the Subset give-up threshold, the honest way to
+        # split ABA votes and flip real threshold coins)
+        self.step_delay_s = float(step_delay_s)
+        # message-class-selective shaping: outbound BINARY-AGREEMENT
+        # traffic is held this long while RBC and the rest flow normally —
+        # decorrelates ABA progress from RBC delivery, which is what
+        # genuinely splits Subset's accept/give-up votes (plain per-link
+        # delay cannot: the RBC echo relay re-equalizes deliveries).
+        # `aba_out_classes` narrows the hold to specific phases (comma
+        # list of span names, e.g. "aba_conf" delays only decisions — the
+        # bench's coin-exercise shape — while BVal/Aux propagate freely
+        # so neither side of a vote split gets flooded out); empty = all
+        # aba_* classes.  First member of the ROADMAP's link-shaping
+        # policy zoo.
+        self.aba_out_delay_s = float(aba_out_delay_s)
+        self.aba_out_classes = frozenset(
+            c.strip() for c in aba_out_classes.split(",") if c.strip()
+        )
+        self.pump = StepPump(self, pipeline_depth=self.pipeline_depth)
+        self._out: Optional[_PumpOutcome] = None
+        # park threshold-decrypt share verification in the protocols so
+        # the pump can resolve ALL in-flight epochs' sets in one merged
+        # crypto.batch call per iteration (no-op for unencrypted runs)
+        self._enable_deferred_crypto()
         # one registry per node: every layer below (transport, mempool,
         # span tracer, fault tallies) registers onto it, and /metrics
         # exposes it live (see hbbft_tpu.obs)
@@ -150,10 +225,12 @@ class NodeRuntime:
             self.flight = FlightObserver(recorder)
             self.spans.sink = self.flight.record_span
         # per-peer replay log of recently sent consensus messages, in send
-        # order: the reinit_peer history (see module docstring).  The
-        # companion set dedups by value so reinit re-sends don't duplicate
-        # the log (protocol messages are frozen dataclasses — hashable)
-        self._replay: Dict[NodeId, List[Tuple[EpochKey, Any]]] = {}
+        # order: the reinit_peer history (see module docstring).  Entries
+        # are (key, message, payload) — the companion set dedups on
+        # (key, payload) BYTES so reinit re-sends don't duplicate the log
+        # (hashing the wire bytes is C-speed; hashing the frozen-dataclass
+        # chains recursively was a measurable slice of _dispatch)
+        self._replay: Dict[NodeId, List[Tuple[EpochKey, Any, bytes]]] = {}
         self._replay_seen: Dict[NodeId, set] = {}
         self._clients: set = set()
         self.transport = Transport(
@@ -172,6 +249,22 @@ class NodeRuntime:
         )
         self._obs_server: Optional[ObsServer] = None
         self.obs_addr: Optional[Addr] = None
+        # HBBFT_PUMP_TIMING=1: accumulate per-segment thread time in the
+        # pump (perf diagnosis; dumped by run_node on shutdown)
+        self._pump_timing: Optional[Dict[str, float]] = (
+            {} if os.environ.get("HBBFT_PUMP_TIMING") else None
+        )
+        self.transport.timing = self._pump_timing
+        self._decode_cache: Dict[bytes, Any] = {}
+        # HBBFT_PUMP_RECORD=<dir>: journal pump events as JSONL for
+        # offline replay profiling (only with timing enabled)
+        self._pump_record = None
+        rec_dir = os.environ.get("HBBFT_PUMP_RECORD")
+        if rec_dir and self._pump_timing is not None:
+            os.makedirs(rec_dir, exist_ok=True)
+            self._pump_record = open(
+                os.path.join(rec_dir,
+                             f"events-{self.sq.our_id()!r}.jsonl"), "w")
 
     # -- observability -------------------------------------------------------
     #
@@ -219,10 +312,21 @@ class NodeRuntime:
                 "peers with a live outbound connection").set(sum(
                     1 for p in self.transport.peer_ids()
                     if self.transport.connected(p)))
+        # pipelining health: how many epochs this node currently keeps
+        # open concurrently, and how deep the pump's event backlog is
+        hb = self._inner_hb()
+        r.gauge("hbbft_node_epochs_in_flight",
+                "epochs with live in-flight consensus state "
+                "(> 1 means the pipeline is engaged)").set(
+                    len(hb.epochs) if hb is not None else 0)
+        r.gauge("hbbft_node_pump_backlog",
+                "events queued for the step pump").set(self.pump.pending())
         g_replay = r.gauge(
             "hbbft_node_replay_log_entries",
             "retained replay-log messages per peer", labelnames=("peer",))
-        for peer, entries in self._replay.items():
+        # list() snapshots: the pump's worker thread mutates these dicts
+        # concurrently with a scrape
+        for peer, entries in list(self._replay.items()):
             g_replay.labels(peer=repr(peer)).set(len(entries))
         g_pera = r.gauge(
             "hbbft_node_peer_era",
@@ -232,11 +336,18 @@ class NodeRuntime:
             "hbbft_node_peer_epoch",
             "last (era, epoch) each peer announced: epoch part",
             labelnames=("peer",))
-        for peer, (p_era, p_epoch) in self.sq.peer_epochs.items():
+        for peer, (p_era, p_epoch) in list(self.sq.peer_epochs.items()):
             if peer == self.our_id():
                 continue
             g_pera.labels(peer=repr(peer)).set(p_era)
             g_pep.labels(peer=repr(peer)).set(p_epoch)
+
+    def _inner_hb(self):
+        """The innermost HoneyBadger of the wrapped stack, if any."""
+        algo = self.sq.algo
+        dhb = getattr(algo, "dhb", algo)
+        return getattr(dhb, "hb", dhb if isinstance(dhb, HoneyBadger)
+                       else None)
 
     async def start_obs(self, host: str = "127.0.0.1",
                         port: int = 0) -> Addr:
@@ -259,22 +370,41 @@ class NodeRuntime:
     def current_key(self) -> EpochKey:
         return _algo_key(self.sq.algo)
 
+    def _enable_deferred_crypto(self) -> None:
+        """Flip the wrapped protocol stack into deferred threshold-decrypt
+        verification (see ``HoneyBadger.defer_decrypt``)."""
+        algo = self.sq.algo
+        dhb = getattr(algo, "dhb", None)
+        if dhb is None and isinstance(algo, DynamicHoneyBadger):
+            dhb = algo
+        if dhb is not None:
+            dhb.defer_decrypt_verify = True
+            dhb.hb.defer_decrypt = True
+        elif isinstance(algo, HoneyBadger):
+            algo.defer_decrypt = True
+
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
-        return await self.transport.listen(host, port)
+        addr = await self.transport.listen(host, port)
+        if self.pump.task is None:
+            self.pump.start()
+        return addr
 
     def connect(self, peer_addrs: Dict[NodeId, Addr]) -> None:
         """Add peers and announce our epoch (SenderQueue startup)."""
         for peer_id, addr in peer_addrs.items():
             if peer_id != self.our_id():
                 self.transport.add_peer(peer_id, addr)
-        self._absorb(self.sq.startup_step())
+        self.pump.enqueue("startup")
 
     async def stop(self) -> None:
         if self._obs_server is not None:
             await self._obs_server.stop()
+        await self.pump.stop()
         await self.transport.stop()
         if self.flight is not None:
             self.flight.close()
+        if self._pump_record is not None:
+            self._pump_record.close()
 
     def flight_crash(self, exc: BaseException) -> None:
         """Crash-dump flush: journal the fatal error and force the
@@ -284,30 +414,186 @@ class NodeRuntime:
             self.flight.on_note("crash", repr(exc))
             self.flight.recorder.flush()
 
-    # -- consensus plumbing --------------------------------------------------
+    # -- ingress (event-loop side): everything protocol-touching enqueues ----
 
     def submit_tx(self, tx: bytes) -> int:
         """Local admission (same path as a client TX frame)."""
         status = self.mempool.add(tx)
         if status == Mempool.ACCEPTED:
-            self._absorb(self.sq.handle_input(self.make_tx_input(tx)))
+            self.pump.enqueue("input", self.make_tx_input(tx))
         return status
 
     def _on_peer_message(self, peer_id: NodeId, payload: bytes) -> None:
+        self.pump.enqueue("msg", peer_id, payload)
+
+    def _on_peer_hello(self, peer_id: NodeId, hello, direction: str) -> None:
+        # ordering with the peer's subsequent messages is preserved by the
+        # FIFO inbox (the hello is enqueued before any MSG frame that
+        # follows it on the socket)
+        self.pump.enqueue("hello", peer_id, hello)
+
+    # -- pump worker (single thread; the only place protocol state mutates) --
+
+    def pump_process(self, events, depth: int) -> _PumpOutcome:
+        """One pump iteration: run ``events`` through the protocol, drain
+        the cross-epoch deferred crypto, top up the epoch pipeline, prune
+        the replay log once.  Runs on the pump's worker thread."""
+        if self.step_delay_s > 0:
+            time.sleep(self.step_delay_s)
+        out = _PumpOutcome()
+        self._out = out
+        t_cpu = time.thread_time()
+        timing = self._pump_timing
         try:
-            msg = wire.decode_message(payload)
-        except ValueError as exc:
-            self.decode_failures += 1
-            logger.warning("undecodable message from %r: %s", peer_id, exc)
+            if timing is not None:
+                self._pump_process_timed(events, depth, timing)
+            else:
+                for kind, args in events:
+                    if kind == "msg":
+                        self._process_peer_message(*args)
+                    elif kind == "input":
+                        self._absorb(self.sq.handle_input(args[0]))
+                    elif kind == "hello":
+                        self._process_peer_hello(*args)
+                    elif kind == "startup":
+                        self._absorb(self.sq.startup_step())
+                    else:  # pragma: no cover - enqueue() callers are local
+                        raise ValueError(f"unknown pump event {kind!r}")
+                self._drain_deferred()
+                if depth > 1:
+                    self._absorb(self.sq.handle_input(PipelineInput(depth)))
+                    self._drain_deferred()
+            self._prune_replay()
+        finally:
+            out.cpu_s = time.thread_time() - t_cpu
+            self._out = None
+        return out
+
+    def _pump_process_timed(self, events, depth: int, timing) -> None:
+        """``HBBFT_PUMP_TIMING`` variant of the iteration body: same
+        semantics, with per-segment thread-time accumulators (decode /
+        protocol / spans / dispatch split inside _process_peer_message is
+        approximated by timing that call whole)."""
+        rec = self._pump_record
+        if rec is not None:
+            for kind, args in events:
+                if kind == "msg":
+                    rec.write('["msg",%d,"%s"]\n'
+                              % (args[0], args[1].hex()))
+                elif kind == "input":
+                    tx = getattr(args[0], "tx", None)
+                    if tx is not None:
+                        rec.write('["input","%s"]\n' % tx.hex())
+        tt = time.thread_time
+        for kind, args in events:
+            t0 = tt()
+            if kind == "msg":
+                self._process_peer_message(*args)
+            elif kind == "input":
+                self._absorb(self.sq.handle_input(args[0]))
+            elif kind == "hello":
+                self._process_peer_hello(*args)
+            elif kind == "startup":
+                self._absorb(self.sq.startup_step())
+            else:  # pragma: no cover - enqueue() callers are local
+                raise ValueError(f"unknown pump event {kind!r}")
+            timing[kind] = timing.get(kind, 0.0) + (tt() - t0)
+            timing["n_" + kind] = timing.get("n_" + kind, 0.0) + 1
+        t0 = tt()
+        self._drain_deferred()
+        if depth > 1:
+            self._absorb(self.sq.handle_input(PipelineInput(depth)))
+            self._drain_deferred()
+        timing["deferred"] = timing.get("deferred", 0.0) + (tt() - t0)
+
+    def _drain_deferred(self) -> None:
+        """Resolve every parked threshold-decrypt verification — ONE
+        merged MSM/pairing call per round via crypto.batch — looping while
+        resolutions cascade into new threshold crossings."""
+        guard = 0
+        while self.sq.has_deferred():
+            self._absorb(self.sq.resolve_deferred())
+            guard += 1
+            if guard > 64:  # pragma: no cover - each round consumes jobs
+                logger.error("deferred-crypto drain did not settle")
+                break
+
+    def pump_flush(self, out: _PumpOutcome) -> None:
+        """Apply one iteration's side effects on the event loop: coalesced
+        MSG/MSG_BATCH frames per peer, then client commit pushes."""
+        timing = self._pump_timing
+        if timing is not None:
+            t0 = time.thread_time()
+            self._pump_flush_body(out)
+            timing["flush"] = (
+                timing.get("flush", 0.0) + (time.thread_time() - t0))
             return
+        self._pump_flush_body(out)
+
+    def _pump_flush_body(self, out: _PumpOutcome) -> None:
+        for dest, payloads in out.frames.items():
+            try:
+                self.transport.send_payloads(dest, payloads)
+            except KeyError:
+                # a target that is not a transport peer (e.g. an observer
+                # known only to the SenderQueue) has nowhere to go yet
+                self.send_failures += 1
+                logger.warning("no transport peer for %r: dropped %d "
+                               "payloads", dest, len(payloads))
+        if out.frames_delayed:
+            loop = asyncio.get_running_loop()
+            for dest, payloads in out.frames_delayed.items():
+                loop.call_later(self.aba_out_delay_s, self._send_shaped,
+                                dest, payloads)
+        for era, epoch, digests in out.commits:
+            self._notify_commit(era, epoch, digests)
+
+    def _send_shaped(self, dest: NodeId, payloads: List[bytes]) -> None:
+        try:
+            self.transport.send_payloads(dest, payloads)
+        except KeyError:
+            self.send_failures += 1
+            logger.warning("no transport peer for %r: dropped %d shaped "
+                           "payloads", dest, len(payloads))
+
+    def _process_peer_message(self, peer_id: NodeId, payload: bytes) -> None:
+        timing = self._pump_timing
+        t0 = time.thread_time() if timing is not None else 0.0
+        # Decode memo: wire messages are frozen/immutable, and much of an
+        # epoch's traffic is byte-identical payloads from different peers
+        # (Ready/BVal/Aux/Conf/Term broadcasts carry no sender field), so
+        # sharing the decoded object is safe and skips the full TLV walk
+        # for ~half the messages.  Bounded: cleared wholesale at the cap,
+        # so a Byzantine payload flood costs reruns, not memory.
+        cache = self._decode_cache
+        msg = cache.get(payload)
+        if msg is not None and timing is not None:
+            timing["n_dec_hit"] = timing.get("n_dec_hit", 0) + 1
+        if msg is None:
+            try:
+                msg = wire.decode_message(payload)
+            except ValueError as exc:
+                self.decode_failures += 1
+                logger.warning("undecodable message from %r: %s",
+                               peer_id, exc)
+                return
+            if len(cache) >= 4096:
+                cache.clear()
+            cache[payload] = msg
         if not isinstance(msg, (AlgoMessage, EpochStarted)):
             self.decode_failures += 1
             logger.warning("non-sender-queue message %s from %r",
                            type(msg).__name__, peer_id)
             return
+        if timing is not None:
+            t1 = time.thread_time()
+            timing["m_decode"] = timing.get("m_decode", 0.0) + (t1 - t0)
         self.spans.on_message(peer_id, msg)
         if self.flight is not None:
             self.flight.on_message(peer_id, msg)
+        if timing is not None:
+            t2 = time.thread_time()
+            timing["m_spans"] = timing.get("m_spans", 0.0) + (t2 - t1)
         try:
             step = self.sq.handle_message(peer_id, msg)
         except TypeError as exc:
@@ -318,9 +604,16 @@ class NodeRuntime:
             logger.warning("protocol-rejected message from %r: %s",
                            peer_id, exc)
             return
+        if timing is not None:
+            t3 = time.thread_time()
+            timing["m_handle"] = timing.get("m_handle", 0.0) + (t3 - t2)
+            self._absorb(step)
+            timing["m_absorb"] = (
+                timing.get("m_absorb", 0.0) + (time.thread_time() - t3))
+            return
         self._absorb(step)
 
-    def _on_peer_hello(self, peer_id: NodeId, hello, direction: str) -> None:
+    def _process_peer_hello(self, peer_id: NodeId, hello) -> None:
         # A hello means a (re)connection: whatever we previously drained
         # into a socket for this peer may have died in TCP buffers, and a
         # below-record key means it restarted outright (possibly from
@@ -333,7 +626,8 @@ class NodeRuntime:
         key = hello.key
         cur = self.sq.peer_epochs.get(peer_id)
         history = [
-            e for e in self._replay.get(peer_id, []) if e[0] >= key
+            (k, m) for k, m, _p in self._replay.get(peer_id, [])
+            if k >= key
         ]
         if history or (cur is not None and key < cur):
             logger.info("peer %r reconnected at %r (recorded %r): "
@@ -380,33 +674,60 @@ class NodeRuntime:
             raise
 
     def _dispatch(self, step: Step) -> None:
+        """Accumulate the step's outbound payloads into the current pump
+        outcome (coalesced + written once per iteration by pump_flush)."""
+        out = self._out
         our = self.our_id()
         peer_ids = self.transport.peer_ids()
         all_ids = peer_ids + [our]
+        max_payload = self.transport.max_frame - 1
+        # The SenderQueue fans a broadcast into one per-peer AlgoMessage
+        # wrapping the SAME inner message object; encoding each copy
+        # costs the hot path ~3× the bytes it needs.  Cache by inner-
+        # object identity — safe because every message in `step` stays
+        # referenced for the duration of this call.
+        enc_cache: Dict[int, bytes] = {}
         for tm in step.messages:
-            payload = wire.encode_message(tm.message)
+            msg = tm.message
+            if isinstance(msg, AlgoMessage):
+                ckey = id(msg.msg)
+                payload = enc_cache.get(ckey)
+                if payload is None:
+                    payload = enc_cache[ckey] = wire.encode_message(msg)
+            else:
+                payload = wire.encode_message(msg)
+            if len(payload) > max_payload:
+                # an oversized frame must not abort the rest of the
+                # Step's fan-out (the mempool's max_tx_bytes admission
+                # bound makes this unreachable for honest configs)
+                self.send_failures += 1
+                logger.error("dropping oversized frame (%d bytes > cap)",
+                             len(payload))
+                continue
             key = (
                 message_key(tm.message.msg)
                 if isinstance(tm.message, AlgoMessage) else None
             )
+            frames = out.frames
+            if self.aba_out_delay_s > 0 and key is not None:
+                from hbbft_tpu.obs.spans import classify
+
+                hit = classify(msg.msg)
+                if hit is not None and hit[2].startswith("aba_") and (
+                    not self.aba_out_classes
+                    or hit[2] in self.aba_out_classes
+                ):
+                    frames = out.frames_delayed
             for dest in tm.target.resolve(all_ids, our):
-                try:
-                    self.transport.send(dest, payload)
-                except framing.FrameError as exc:
-                    # an oversized frame must not abort the rest of the
-                    # Step's fan-out (the mempool's max_tx_bytes admission
-                    # bound makes this unreachable for honest configs)
-                    self.send_failures += 1
-                    logger.error("dropping oversized frame for %r: %s",
-                                 dest, exc)
-                    break  # same payload, same cap: skip remaining dests
+                frames.setdefault(dest, []).append(payload)
                 if key is not None:
-                    entry = (key, tm.message.msg)
+                    dedup = (key, payload)
                     seen = self._replay_seen.setdefault(dest, set())
-                    if entry not in seen:
-                        seen.add(entry)
-                        self._replay.setdefault(dest, []).append(entry)
-        self._prune_replay()
+                    if dedup not in seen:
+                        seen.add(dedup)
+                        self._replay.setdefault(dest, []).append(
+                            (key, msg.msg, payload)
+                        )
 
     def _prune_replay(self) -> None:
         era, epoch = self.current_key()
@@ -422,9 +743,19 @@ class NodeRuntime:
             floor = (era - 1, 0) if era > 0 else (0, 0)
         for dest, entries in self._replay.items():
             if entries and entries[0][0] < floor:
-                kept = [e for e in entries if e[0] >= floor]
-                self._replay[dest] = kept
-                self._replay_seen[dest] = set(kept)
+                # entries are appended in send order (keys non-decreasing
+                # modulo reinit merges), so pruning is a front chop —
+                # incremental, not a full list+set rebuild per epoch
+                i = 0
+                n = len(entries)
+                while i < n and entries[i][0] < floor:
+                    i += 1
+                if i:
+                    seen = self._replay_seen.get(dest)
+                    if seen is not None:
+                        for k, _m, p in entries[:i]:
+                            seen.discard((k, p))
+                    del entries[:i]
 
     # -- batches & clients ---------------------------------------------------
 
@@ -442,7 +773,9 @@ class NodeRuntime:
             txs = batch.all_txs()
             self._c_committed.inc(len(txs))
             digests = self.mempool.mark_committed(txs)
-            self._notify_commit(batch.era, batch.epoch, digests)
+            # client sockets are event-loop objects: the notification is
+            # queued on the outcome and written by pump_flush
+            self._out.commits.append((batch.era, batch.epoch, digests))
         if self.on_batch is not None:
             self.on_batch(batch)
 
@@ -462,12 +795,22 @@ class NodeRuntime:
                          payload: bytes) -> None:
         self._clients.add(conn)
         if kind == framing.TX:
+            # admission (bounded, dedup'd) and the ack stay on the event
+            # loop — backpressure must not wait behind a pump iteration;
+            # only the accepted input crosses into the pump
             status = self.mempool.add(payload)
             conn.send(framing.TX_ACK, bytes([status]) + tx_digest(payload))
             if status == Mempool.ACCEPTED:
-                self._absorb(self.sq.handle_input(self.make_tx_input(payload)))
+                self.pump.enqueue("input", self.make_tx_input(payload))
         elif kind == framing.STATUS_REQ:
-            conn.send(framing.STATUS, json.dumps(self.status_doc()).encode())
+            # optional u32 payload: digest-chain tail length (0 = just the
+            # head/length — the cheap poll loops use this; the full
+            # 256-entry default costs ~16 KB of JSON per request)
+            tail = 256
+            if len(payload) == 4:
+                tail = struct.unpack(">I", payload)[0]
+            conn.send(framing.STATUS,
+                      json.dumps(self.status_doc(chain_tail=tail)).encode())
         else:
             logger.warning("unknown client frame kind %d", kind)
 
@@ -502,6 +845,11 @@ class NodeRuntime:
                 if self.transport.connected(p)
             ),
             "epochs_traced": self.spans.epochs_finalized,
+            "pipeline_depth": self.pipeline_depth,
+            "epochs_in_flight": (
+                len(self._inner_hb().epochs)
+                if self._inner_hb() is not None else 0
+            ),
             "obs_addr": list(self.obs_addr) if self.obs_addr else None,
             "stats": self.transport.stats.as_dict(),
         }

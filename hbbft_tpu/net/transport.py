@@ -50,6 +50,7 @@ import contextlib
 import hashlib
 import logging
 import random
+import socket
 import struct
 import time
 from collections import deque
@@ -70,6 +71,17 @@ NodeId = Hashable
 Addr = Tuple[str, int]
 
 logger = logging.getLogger("hbbft_tpu.net")
+
+
+def set_nodelay(writer: asyncio.StreamWriter) -> None:
+    """Disable Nagle on a stream's socket.  Consensus frames are tiny
+    (~70 B) and latency-critical; Nagle + delayed-ACK otherwise holds
+    them back up to 40 ms waiting to coalesce with traffic that never
+    comes."""
+    sock = writer.get_extra_info("socket")
+    if sock is not None:
+        with contextlib.suppress(OSError):  # non-TCP / already-closed socket
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
 
 class BackoffPolicy:
@@ -205,6 +217,22 @@ class TransportStats:
             buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0))
         self.reconnects = _LabeledCounterView(self._reconnects)
         self.backoff_delays: Dict[NodeId, List[float]] = {}
+        # hot-path handles: _record_send/_record_recv run per frame, and
+        # the MetricAttr `+= 1` shim costs a registry read + a set each —
+        # these direct child references make the per-frame accounting two
+        # plain ``inc`` calls (part of the r01→r02 obs-overhead fix)
+        self._c_frames_sent = self._frames_sent._default()
+        self._c_bytes_sent = self._bytes_sent._default()
+        self._c_frames_recv = self._frames_recv._default()
+        self._c_bytes_recv = self._bytes_recv._default()
+
+    def frame_sent(self, nbytes: int) -> None:
+        self._c_frames_sent.inc()
+        self._c_bytes_sent.inc(nbytes)
+
+    def frame_recv(self, nbytes: int) -> None:
+        self._c_frames_recv.inc()
+        self._c_bytes_recv.inc(nbytes)
 
     # -- attribute views (the pre-registry dataclass API) -------------------
 
@@ -292,7 +320,13 @@ class _PeerSender:
         self.t = transport
         self.peer_id = peer_id
         self.addr = addr
-        self.outbox: Deque[bytes] = deque()
+        # entries are (ready_at, frame): ready_at is 0.0 on an unshaped
+        # link; with a link_delays entry it is enqueue time + delay — the
+        # drainer holds frames back until they are "due", modeling link
+        # latency without serializing throughput (scenario shaping for
+        # the bench/chaos harnesses)
+        self.delay = float(transport.link_delays.get(peer_id, 0.0))
+        self.outbox: Deque[Tuple[float, bytes]] = deque()
         self.wake = asyncio.Event()
         self.connected = asyncio.Event()
         self.stopped = False
@@ -307,7 +341,8 @@ class _PeerSender:
         )
 
     def send(self, frame: bytes) -> None:
-        self.outbox.append(frame)
+        ready = time.monotonic() + self.delay if self.delay > 0 else 0.0
+        self.outbox.append((ready, frame))
         peak = len(self.outbox)
         if peak > self.t.stats.send_queue_peak:
             self.t.stats.send_queue_peak = peak
@@ -324,6 +359,7 @@ class _PeerSender:
             except (OSError, asyncio.TimeoutError):
                 attempt = await self._backoff(attempt)
                 continue
+            set_nodelay(writer)
             try:
                 hello = await self._handshake(reader, writer)
             except (OSError, asyncio.TimeoutError, FrameError,
@@ -415,14 +451,30 @@ class _PeerSender:
                 await self.wake.wait()
                 self.wake.clear()
                 while self.outbox:
-                    frame = self.outbox[0]
+                    ready = self.outbox[0][0]
+                    if ready:
+                        now = time.monotonic()
+                        if ready > now:  # shaped link: frame not due yet
+                            await asyncio.sleep(ready - now)
+                    # write every queued (due) frame, then ONE drain for
+                    # the lot — per-frame drains cost a writer round trip
+                    # each and dominated the sequential-path profile
+                    now = time.monotonic() if self.delay > 0 else None
+                    batch = []
+                    for r, f in self.outbox:
+                        if now is not None and r > now:
+                            break
+                        batch.append(f)
                     async with wlock:
-                        writer.write(frame)
+                        for f in batch:
+                            writer.write(f)
                         await writer.drain()
-                    # popped only after a successful drain: a frame in
-                    # flight when the socket dies is re-sent (at-least-once)
-                    self.outbox.popleft()
-                    self.t._record_send(self.peer_id, frame)
+                    # popped only after a successful drain: frames in
+                    # flight when the socket dies are re-sent
+                    # (at-least-once)
+                    for f in batch:
+                        self.outbox.popleft()
+                        self.t._record_send(self.peer_id, f)
 
         async def ping_once():
             frame = framing.encode_frame(
@@ -473,9 +525,14 @@ class _PeerSender:
                     logger.debug("connection to %r dropped: %r",
                                  self.peer_id, exc)
         finally:
-            for task in tasks:
-                task.cancel()
-            await asyncio.gather(*tasks, return_exceptions=True)
+            # re-cancel until done: ping_once sits under a wait_for, and a
+            # cancel landing as it completes is swallowed on CPython 3.10
+            # (bpo-42130) — see Transport.stop
+            live = {t for t in tasks if not t.done()}
+            while live:
+                for task in live:
+                    task.cancel()
+                _done, live = await asyncio.wait(live, timeout=1.0)
 
     async def stop(self) -> None:
         self.stopped = True
@@ -514,6 +571,7 @@ class Transport:
         trace=None,
         cost_model=None,
         registry=None,
+        link_delays: Optional[Dict[NodeId, float]] = None,
     ):
         self.our_id = our_id
         self.cluster_id = bytes(cluster_id)
@@ -528,12 +586,18 @@ class Transport:
         self.client_idle_timeout_s = client_idle_timeout_s
         self.max_frame = max_frame
         self.backoff = backoff or BackoffPolicy(seed=seed)
+        # per-peer OUTBOUND latency shaping (seconds): scenario/bench
+        # harness knob — frames to a shaped peer are held until
+        # enqueue + delay before hitting the socket (see _PeerSender)
+        self.link_delays: Dict[NodeId, float] = dict(link_delays or {})
         self.trace = trace
         self.cost_model = cost_model
         self.stats = TransportStats(registry)
         self._senders: Dict[NodeId, _PeerSender] = {}
+        self._peer_ids_cache: Optional[List[NodeId]] = None
         self._server: Optional[asyncio.base_events.Server] = None
         self._inbound_tasks: set = set()
+        self._stopping = False
         self.addr: Optional[Addr] = None
 
     # -- lifecycle -----------------------------------------------------------
@@ -551,22 +615,36 @@ class Transport:
             raise ValueError(f"peer {peer_id!r} already added")
         sender = _PeerSender(self, peer_id, addr)
         self._senders[peer_id] = sender
+        self._peer_ids_cache = None
         sender.start()
 
     async def stop(self) -> None:
+        self._stopping = True
         for sender in self._senders.values():
             await sender.stop()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
-        for task in list(self._inbound_tasks):
-            task.cancel()
-        await asyncio.gather(*self._inbound_tasks, return_exceptions=True)
+        # cancel inbound handlers and wait RE-CANCELLING: on CPython 3.10
+        # a cancel that lands exactly as a wait_for's inner read completes
+        # is swallowed (bpo-42130) and the recv loop keeps running — one
+        # plain gather here then hangs forever (observed ~1-in-3 at
+        # in-process cluster shutdown).  The loops also check _stopping so
+        # a swallowed cancel exits at its next iteration either way.
+        pending = {t for t in self._inbound_tasks if not t.done()}
+        while pending:
+            for task in pending:
+                task.cancel()
+            _done, pending = await asyncio.wait(pending, timeout=1.0)
 
     # -- sending -------------------------------------------------------------
 
     def peer_ids(self) -> List[NodeId]:
-        return sorted(self._senders.keys(), key=repr)
+        # called once per dispatched Step — cache the sorted list (peers
+        # are only ever added via add_peer, which invalidates)
+        if self._peer_ids_cache is None:
+            self._peer_ids_cache = sorted(self._senders.keys(), key=repr)
+        return self._peer_ids_cache
 
     def connected(self, peer_id: NodeId) -> bool:
         sender = self._senders.get(peer_id)
@@ -586,6 +664,16 @@ class Transport:
             raise KeyError(f"unknown peer {peer_id!r}")
         sender.send(framing.encode_frame(kind, payload, self.max_frame))
 
+    def send_payloads(self, peer_id: NodeId, payloads) -> None:
+        """Queue many consensus payloads for ``peer_id``, coalesced into
+        as few MSG/MSG_BATCH frames as the cap allows — the pump's
+        per-iteration write path (:func:`framing.pack_msgs`)."""
+        sender = self._senders.get(peer_id)
+        if sender is None:
+            raise KeyError(f"unknown peer {peer_id!r}")
+        for frame in framing.pack_msgs(payloads, self.max_frame):
+            sender.send(frame)
+
     def local_hello(self) -> Hello:
         era, epoch = self.hello_key()
         return Hello(node_id=self.our_id, role=ROLE_NODE,
@@ -597,6 +685,7 @@ class Transport:
                       writer: asyncio.StreamWriter) -> None:
         task = asyncio.current_task()
         self._inbound_tasks.add(task)
+        set_nodelay(writer)
         try:
             await self._serve_inbound(reader, writer)
         except (
@@ -638,6 +727,26 @@ class Transport:
         else:
             await self._client_recv_loop(hello, reader, writer)
 
+    async def _idle_watchdog(self, writer: asyncio.StreamWriter,
+                             state: list, idle_timeout: float) -> None:
+        """Close ``writer`` once ``state[0]`` (last-data time) goes stale.
+
+        One long-lived task per connection instead of an
+        ``asyncio.wait_for`` per read: wait_for creates and cancels a
+        Task + timer handle around EVERY chunk, which was a measurable
+        slice of the per-epoch event-loop CPU.  Closing the transport
+        unblocks the pending read (EOF/reset), and ``state[1]`` tells
+        the recv loop the EOF was an idle kill so the drop accounting
+        is unchanged."""
+        while True:
+            deadline = state[0] + idle_timeout
+            now = time.monotonic()
+            if now >= deadline:
+                state[1] = True
+                writer.close()
+                return
+            await asyncio.sleep(deadline - now + 0.05)
+
     async def _node_recv_loop(self, peer_id: NodeId,
                               reader: asyncio.StreamReader,
                               writer: asyncio.StreamWriter) -> None:
@@ -647,27 +756,64 @@ class Transport:
         # partition): time the read out or this task and its fd would
         # leak forever — the dialer side re-dials with a fresh connection
         idle_timeout = self.dead_after_s * 2 + 1.0
-        while True:
-            data = await asyncio.wait_for(reader.read(65536), idle_timeout)
+        state = [time.monotonic(), False]
+        watchdog = asyncio.get_running_loop().create_task(
+            self._idle_watchdog(writer, state, idle_timeout)
+        )
+        try:
+            await self._node_recv_inner(peer_id, reader, writer,
+                                        decoder, state)
+        finally:
+            watchdog.cancel()
+
+    async def _node_recv_inner(self, peer_id: NodeId,
+                               reader: asyncio.StreamReader,
+                               writer: asyncio.StreamWriter,
+                               decoder: FrameDecoder, state: list) -> None:
+        timing = getattr(self, "timing", None)
+        while not self._stopping:
+            data = await reader.read(65536)
             if not data:
+                if state[1]:
+                    raise asyncio.TimeoutError(
+                        f"peer {peer_id!r} recv idle timeout")
                 return
-            for kind, payload in decoder.feed(data):
-                self._record_recv(peer_id, kind, payload)
-                if kind == framing.PING:
-                    pong = framing.encode_frame(
-                        framing.PONG, payload, self.max_frame
-                    )
-                    writer.write(pong)
-                    await writer.drain()
-                    self._record_send(peer_id, pong)
-                elif kind == framing.MSG:
-                    if self.on_peer_message is not None:
-                        self.on_peer_message(peer_id, payload)
-                else:
-                    raise FrameError(
-                        f"unexpected frame kind {kind} from node "
-                        f"{peer_id!r}"
-                    )
+            state[0] = time.monotonic()
+            if timing is None:
+                self._recv_chunk(peer_id, writer, decoder, data)
+            else:
+                t0 = time.thread_time()
+                self._recv_chunk(peer_id, writer, decoder, data)
+                timing["recv"] = (
+                    timing.get("recv", 0.0) + (time.thread_time() - t0))
+                timing["n_recv"] = timing.get("n_recv", 0) + 1
+
+    def _recv_chunk(self, peer_id: NodeId, writer: asyncio.StreamWriter,
+                    decoder: FrameDecoder, data: bytes) -> None:
+        """One chunk of the node recv path — synchronous on purpose: the
+        PONG reply is written without an awaited drain (a 15-byte reply
+        to a rare heartbeat; asyncio flushes it on the next loop pass),
+        which keeps the whole per-chunk path free of coroutine hops."""
+        for kind, payload in decoder.feed(data):
+            self._record_recv(peer_id, kind, payload)
+            if kind == framing.PING:
+                pong = framing.encode_frame(
+                    framing.PONG, payload, self.max_frame
+                )
+                writer.write(pong)
+                self._record_send(peer_id, pong)
+            elif kind == framing.MSG:
+                if self.on_peer_message is not None:
+                    self.on_peer_message(peer_id, payload)
+            elif kind == framing.MSG_BATCH:
+                if self.on_peer_message is not None:
+                    for sub in framing.split_msgs(payload):
+                        self.on_peer_message(peer_id, sub)
+            else:
+                raise FrameError(
+                    f"unexpected frame kind {kind} from node "
+                    f"{peer_id!r}"
+                )
 
     async def _client_recv_loop(self, hello: Hello,
                                 reader: asyncio.StreamReader,
@@ -675,15 +821,22 @@ class Transport:
         conn = ClientConn(hello, writer, self.max_frame,
                           record_send=self._record_send, stats=self.stats)
         decoder = FrameDecoder(self.max_frame)
+        # clients keep-alive every ~10 s (ClusterClient); longer silence
+        # is a half-open socket — reclaim the task/fd (idle watchdog, not
+        # a per-read wait_for: see _idle_watchdog)
+        state = [time.monotonic(), False]
+        watchdog = asyncio.get_running_loop().create_task(
+            self._idle_watchdog(writer, state, self.client_idle_timeout_s)
+        )
         try:
-            while True:
-                # clients keep-alive every ~10 s (ClusterClient); longer
-                # silence is a half-open socket — reclaim the task/fd
-                data = await asyncio.wait_for(
-                    reader.read(65536), self.client_idle_timeout_s
-                )
+            while not self._stopping:
+                data = await reader.read(65536)
                 if not data:
+                    if state[1]:
+                        raise asyncio.TimeoutError(
+                            f"client {hello.node_id!r} recv idle timeout")
                     return
+                state[0] = time.monotonic()
                 for kind, payload in decoder.feed(data):
                     self._record_recv(hello.node_id, kind, payload)
                     if kind == framing.PING:
@@ -691,6 +844,7 @@ class Transport:
                     elif self.on_client_frame is not None:
                         self.on_client_frame(conn, kind, payload)
         finally:
+            watchdog.cancel()
             conn.closed = True
             if self.on_client_gone is not None:
                 self.on_client_gone(conn)
@@ -703,8 +857,7 @@ class Transport:
             self.on_peer_hello(peer_id, hello, direction)
 
     def _record_send(self, peer_id: NodeId, frame: bytes) -> None:
-        self.stats.frames_sent += 1
-        self.stats.bytes_sent += len(frame)
+        self.stats.frame_sent(len(frame))
         if self.trace is not None:
             from hbbft_tpu.sim.trace import NetEvent
 
@@ -717,8 +870,7 @@ class Transport:
     def _record_recv(self, peer_id: NodeId, kind: int,
                      payload: bytes) -> None:
         nbytes = len(payload) + 5
-        self.stats.frames_recv += 1
-        self.stats.bytes_recv += nbytes
+        self.stats.frame_recv(nbytes)
         if self.cost_model is not None:
             self.stats.virtual_cost_s += self.cost_model.charge(nbytes)
         if self.trace is not None:

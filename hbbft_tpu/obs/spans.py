@@ -154,6 +154,11 @@ class SpanTracer(StepObserver):
             "inbound consensus messages classified per phase",
             labelnames=("phase",), max_label_sets=len(PHASE_ORDER) + 1,
         )
+        # per-phase child handles: labels() costs a tuple build + dict
+        # lookup per call, and on_message runs once per consensus message
+        # — caching the children was part of recovering the r01→r02
+        # sequential-throughput regression
+        self._msg_children: Dict[str, Any] = {}
         self._h_epoch = r.histogram(
             "hbbft_node_epoch_duration_seconds",
             "first phase activity to batch commit, per epoch",
@@ -176,7 +181,11 @@ class SpanTracer(StepObserver):
             return
         era, epoch, phase, rnd = hit
         now = self.clock() if t is None else t
-        self._c_msgs.labels(phase=phase).inc()
+        child = self._msg_children.get(phase)
+        if child is None:
+            child = self._msg_children[phase] = self._c_msgs.labels(
+                phase=phase)
+        child.inc()
         if phase == "dkg_rotation":
             agg = self._dkg_open.get(era)
             if agg is None:
@@ -283,17 +292,68 @@ class SpanTracer(StepObserver):
     # -- export --------------------------------------------------------------
 
     def spans_for(self, era: int, epoch: int) -> List[Span]:
-        return [s for s in self.finished
+        # list() first: exports run on the obs event loop while the pump's
+        # worker thread finalizes epochs into the deque
+        return [s for s in list(self.finished)
                 if s.era == era and s.epoch == epoch]
 
     def export_jsonl(self) -> str:
         """One JSON object per finished span, in finalization order."""
+        finished = list(self.finished)
         return "\n".join(
-            json.dumps(s.as_dict()) for s in self.finished
-        ) + ("\n" if self.finished else "")
+            json.dumps(s.as_dict()) for s in finished
+        ) + ("\n" if finished else "")
 
 
 # -- message classification --------------------------------------------------
+
+# The protocol message types classify() dispatches on, resolved ONCE on
+# first use: obs must stay importable without dragging protocol modules in
+# at module-import time (tools and tests import obs alone), but re-running
+# a dozen import statements per message was the dominant per-message cost
+# the r01→r02 obs regression traced to.
+_T = None
+
+
+class _ClassifyTypes:
+    __slots__ = (
+        "AlgoMessage", "KeyGenWrap", "HbWrap", "DecryptionShareWrap",
+        "SubsetWrap", "BroadcastWrap", "AgreementWrap", "ValueMsg",
+        "EchoLike", "ReadyMsg", "BValMsg", "AuxMsg", "ConfMsg", "CoinMsg",
+        "TermMsg",
+    )
+
+    def __init__(self):
+        from hbbft_tpu.protocols.binary_agreement import (
+            AuxMsg, BValMsg, CoinMsg, ConfMsg, TermMsg,
+        )
+        from hbbft_tpu.protocols.broadcast import (
+            CanDecodeMsg, EchoHashMsg, EchoMsg, ReadyMsg, ValueMsg,
+        )
+        from hbbft_tpu.protocols.dynamic_honey_badger import (
+            HbWrap, KeyGenWrap,
+        )
+        from hbbft_tpu.protocols.honey_badger import (
+            DecryptionShareWrap, SubsetWrap,
+        )
+        from hbbft_tpu.protocols.sender_queue import AlgoMessage
+        from hbbft_tpu.protocols.subset import AgreementWrap, BroadcastWrap
+
+        self.AlgoMessage = AlgoMessage
+        self.KeyGenWrap = KeyGenWrap
+        self.HbWrap = HbWrap
+        self.DecryptionShareWrap = DecryptionShareWrap
+        self.SubsetWrap = SubsetWrap
+        self.BroadcastWrap = BroadcastWrap
+        self.AgreementWrap = AgreementWrap
+        self.ValueMsg = ValueMsg
+        self.EchoLike = (EchoMsg, EchoHashMsg, CanDecodeMsg)
+        self.ReadyMsg = ReadyMsg
+        self.BValMsg = BValMsg
+        self.AuxMsg = AuxMsg
+        self.ConfMsg = ConfMsg
+        self.CoinMsg = CoinMsg
+        self.TermMsg = TermMsg
 
 
 def classify(message: Any
@@ -301,55 +361,44 @@ def classify(message: Any
     """``(era, epoch, phase, round)`` for a consensus message, walking the
     wrapper chain; ``None`` for control traffic (EpochStarted, heartbeats)
     that belongs to no epoch phase."""
-    # local imports: obs must stay importable without dragging protocol
-    # modules in at module-import time (tools and tests import obs alone)
-    from hbbft_tpu.protocols.binary_agreement import (
-        AuxMsg, BValMsg, CoinMsg, ConfMsg, TermMsg,
-    )
-    from hbbft_tpu.protocols.broadcast import (
-        CanDecodeMsg, EchoHashMsg, EchoMsg, ReadyMsg, ValueMsg,
-    )
-    from hbbft_tpu.protocols.dynamic_honey_badger import HbWrap, KeyGenWrap
-    from hbbft_tpu.protocols.honey_badger import (
-        DecryptionShareWrap, SubsetWrap,
-    )
-    from hbbft_tpu.protocols.sender_queue import AlgoMessage
-    from hbbft_tpu.protocols.subset import AgreementWrap, BroadcastWrap
-
+    global _T
+    T = _T
+    if T is None:
+        T = _T = _ClassifyTypes()
     era = 0
-    if isinstance(message, AlgoMessage):
+    if isinstance(message, T.AlgoMessage):
         message = message.msg
-    if isinstance(message, KeyGenWrap):
+    if isinstance(message, T.KeyGenWrap):
         return (message.era, 0, "dkg_rotation", None)
-    if isinstance(message, HbWrap):
+    if isinstance(message, T.HbWrap):
         era = message.era
         message = message.msg
-    if isinstance(message, DecryptionShareWrap):
+    if isinstance(message, T.DecryptionShareWrap):
         return (era, message.epoch, "decrypt_share", None)
-    if not isinstance(message, SubsetWrap):
+    if not isinstance(message, T.SubsetWrap):
         return None
     epoch = message.epoch
     inner = message.msg
-    if isinstance(inner, BroadcastWrap):
+    if isinstance(inner, T.BroadcastWrap):
         m = inner.msg
-        if isinstance(m, ValueMsg):
+        if isinstance(m, T.ValueMsg):
             return (era, epoch, "rbc_value", None)
-        if isinstance(m, (EchoMsg, EchoHashMsg, CanDecodeMsg)):
+        if isinstance(m, T.EchoLike):
             return (era, epoch, "rbc_echo", None)
-        if isinstance(m, ReadyMsg):
+        if isinstance(m, T.ReadyMsg):
             return (era, epoch, "rbc_ready", None)
         return None
-    if isinstance(inner, AgreementWrap):
+    if isinstance(inner, T.AgreementWrap):
         m = inner.msg
-        if isinstance(m, BValMsg):
+        if isinstance(m, T.BValMsg):
             return (era, epoch, "aba_bval", m.epoch)
-        if isinstance(m, AuxMsg):
+        if isinstance(m, T.AuxMsg):
             return (era, epoch, "aba_aux", m.epoch)
-        if isinstance(m, ConfMsg):
+        if isinstance(m, T.ConfMsg):
             return (era, epoch, "aba_conf", m.epoch)
-        if isinstance(m, CoinMsg):
+        if isinstance(m, T.CoinMsg):
             return (era, epoch, "aba_coin", m.epoch)
-        if isinstance(m, TermMsg):
+        if isinstance(m, T.TermMsg):
             return (era, epoch, "aba_term", None)
         return None
     return None

@@ -71,6 +71,15 @@ class CanDecodeMsg:
 
 BroadcastMessage = object  # ValueMsg | EchoMsg | ReadyMsg | EchoHash | CanDecode
 
+#: ``CanDecode`` pays for itself only when the echo shards it suppresses
+#: outweigh the announcement messages themselves (~40 framed bytes to
+#: N−k peers, plus a full decode/handle pass at every receiver).  Below
+#: this shard size the optimization is strictly negative — at the bench
+#: shape (64 B txs, shards < 300 B) it added ~8 messages per epoch per
+#: node for nothing — so tiny-payload broadcasts skip the announcement.
+#: Module knob: MB-scale RBC deployments can tune it.
+CAN_DECODE_MIN_SHARD_BYTES = 256
+
 
 class Broadcast(ConsensusProtocol):
     """Reference: ``src/broadcast/broadcast.rs :: Broadcast<N>``."""
@@ -271,9 +280,14 @@ class Broadcast(ConsensusProtocol):
             and self._count_echos(root) >= self.data_shard_num
         ):
             self.can_decode_sent.add(root)
-            step.send(
-                Target.all_except(set(self.echos)), CanDecodeMsg(root)
+            shard_len = max(
+                len(p.value)
+                for p in self.echos.values() if p.root_hash == root
             )
+            if shard_len >= CAN_DECODE_MIN_SHARD_BYTES:
+                step.send(
+                    Target.all_except(set(self.echos)), CanDecodeMsg(root)
+                )
         return step
 
     def _handle_ready(self, sender_id: NodeId, root: bytes) -> Step:

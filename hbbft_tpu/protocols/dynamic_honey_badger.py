@@ -458,6 +458,10 @@ class DynamicHoneyBadger(ConsensusProtocol):
         self.contribution_provider: Optional[Any] = None
         self.empty_contribution: bytes = b""
         self.era_has_batches = False
+        # epoch-pipelined runtimes set this (see HoneyBadger.defer_decrypt);
+        # it must survive era rotation, so it lives here and _make_hb
+        # stamps every inner HoneyBadger with it
+        self.defer_decrypt_verify = False
         self.hb = self._make_hb()
 
     @classmethod
@@ -490,13 +494,15 @@ class DynamicHoneyBadger(ConsensusProtocol):
         )
 
     def _make_hb(self) -> HoneyBadger:
-        return HoneyBadger(
+        hb = HoneyBadger(
             self.netinfo,
             session_id=b"dhb-era-" + wire.u64(self.era),
             max_future_epochs=self.max_future_epochs,
             encryption_schedule=self.encryption_schedule,
             rng=random.Random(self.rng.getrandbits(64)),
         )
+        hb.defer_decrypt = self.defer_decrypt_verify
+        return hb
 
     # -- pickling (snapshot/restore support) ---------------------------------
 
@@ -537,6 +543,37 @@ class DynamicHoneyBadger(ConsensusProtocol):
         )
         inner = self.hb.propose(contrib.to_bytes())
         return self._process_hb_step(inner)
+
+    def propose_ahead(self, contribution: bytes, offset: int) -> Step:
+        """Propose into epoch ``hb.epoch + offset`` of the CURRENT era —
+        the epoch-pipelining entry (``offset=0`` is plain ``propose``).
+
+        The wrapped payload carries this node's pending votes/key-gen
+        messages exactly like a current-epoch proposal; if the era rotates
+        before the future epoch completes, its in-flight state dies with
+        the old inner HoneyBadger and the transactions simply get
+        re-proposed in the new era (they leave the queue only on commit).
+        """
+        if not self.is_validator():
+            return Step()
+        contrib = InternalContrib(
+            contribution=bytes(contribution),
+            votes=self.vote_counter.pending_votes(),
+            key_gen_msgs=list(self.pending_kg),
+        )
+        inner = self.hb.propose_into(
+            self.hb.epoch + offset, contrib.to_bytes()
+        )
+        return self._process_hb_step(inner)
+
+    def has_deferred(self) -> bool:
+        return self.hb.has_deferred()
+
+    def resolve_deferred(self) -> Step:
+        """Drain the inner HoneyBadger's parked decrypt verifications
+        (see ``HoneyBadger.resolve_deferred``), with batch/era processing
+        applied to whatever completes."""
+        return self._process_hb_step(self.hb.resolve_deferred())
 
     def vote_for(self, change: Change) -> Step:
         """Sign and queue a vote (committed via a later contribution).

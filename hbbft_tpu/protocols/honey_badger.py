@@ -218,6 +218,14 @@ class HoneyBadger(ConsensusProtocol):
         self.epochs: Dict[int, _EpochState] = {}
         self.has_input: Dict[int, bool] = {}
         self.completed: Dict[int, Batch] = {}
+        # Deferred threshold-decrypt verification (the epoch-pipelined
+        # runtime's cross-epoch crypto seam): when True, every
+        # ThresholdDecrypt this instance creates parks its t+1 share-set
+        # verification here instead of pairing inline; the pump drains
+        # them via resolve_deferred() as ONE merged device/pairing call
+        # per iteration.  False (default) keeps the simulator-exact path.
+        self.defer_decrypt = False
+        self._deferred_decrypts: List[Tuple[int, NodeId, Any]] = []
 
     @classmethod
     def builder(cls, netinfo: NetworkInfo) -> HoneyBadgerBuilder:
@@ -243,10 +251,23 @@ class HoneyBadger(ConsensusProtocol):
         Reference: ``HoneyBadger::propose`` (HOT: TPKE encrypt —
         G1/G2 scalar muls).
         """
-        if self.has_input.get(self.epoch):
+        return self.propose_into(self.epoch, contribution)
+
+    def propose_into(self, epoch: int, contribution: bytes) -> Step:
+        """Propose into ``epoch`` — the current one (``propose``) or a
+        future one within the ``max_future_epochs`` window.
+
+        This is the epoch-pipelining seam: the protocol already accepts
+        peers' messages up to ``max_future_epochs`` ahead, so a proposer
+        may open epoch e+k's Subset while epoch e is still threshold-
+        decrypting.  Out-of-window or already-proposed epochs are no-ops.
+        """
+        if epoch < self.epoch or epoch > self.epoch + self.max_future_epochs:
             return Step()
-        self.has_input[self.epoch] = True
-        if self.encryption_schedule.encrypt_on_epoch(self.epoch):
+        if self.has_input.get(epoch):
+            return Step()
+        self.has_input[epoch] = True
+        if self.encryption_schedule.encrypt_on_epoch(epoch):
             ct = (
                 self.netinfo.public_key_set()
                 .public_key()
@@ -255,9 +276,9 @@ class HoneyBadger(ConsensusProtocol):
             payload = bytes([_ENCRYPTED]) + ct.to_bytes()
         else:
             payload = bytes([_PLAIN]) + bytes(contribution)
-        state = self._epoch_state(self.epoch)
+        state = self._epoch_state(epoch)
         inner = state.subset.handle_input(payload)
-        return self._process_subset_step(self.epoch, inner)
+        return self._process_subset_step(epoch, inner)
 
     def handle_message(self, sender_id: NodeId, message) -> Step:
         if not self.netinfo.is_node_validator(sender_id):
@@ -295,8 +316,52 @@ class HoneyBadger(ConsensusProtocol):
 
     def _decrypt_for(self, state: _EpochState, proposer_id: NodeId) -> ThresholdDecrypt:
         if proposer_id not in state.decrypts:
-            state.decrypts[proposer_id] = ThresholdDecrypt(self.netinfo)
+            td = ThresholdDecrypt(self.netinfo)
+            if self.defer_decrypt:
+                epoch = state.epoch
+                td.defer_verify = (
+                    lambda inst, e=epoch, p=proposer_id:
+                    self._deferred_decrypts.append((e, p, inst))
+                )
+            state.decrypts[proposer_id] = td
         return state.decrypts[proposer_id]
+
+    # -- deferred threshold-decrypt verification (pipelined pump seam) -------
+
+    def has_deferred(self) -> bool:
+        return bool(self._deferred_decrypts)
+
+    def resolve_deferred(self) -> Step:
+        """Verify every parked t+1 share set in ONE merged call and resume
+        the instances (see ``crypto.batch.verify_dec_share_sets``).  The
+        pump calls this at the end of each iteration, so the shares of all
+        epochs in flight verify together — cross-epoch batched threshold
+        crypto — instead of one pairing check per (epoch, proposer)."""
+        if not self._deferred_decrypts:
+            return Step()
+        from hbbft_tpu.crypto.batch import verify_dec_share_sets
+
+        jobs, self._deferred_decrypts = self._deferred_decrypts, []
+        pks = self.netinfo.public_key_set()
+        live = []
+        for epoch, proposer, td in jobs:
+            # an era rotation or epoch close can orphan a parked job —
+            # nothing to resume then
+            if epoch not in self.epochs or td.deferred_job() is None:
+                continue
+            live.append((epoch, proposer, td))
+        if not live:
+            return Step()
+        oks = verify_dec_share_sets([
+            (pks,) + td.deferred_job() for _e, _p, td in live
+        ])
+        step = Step()
+        for (epoch, proposer, td), ok in zip(live, oks):
+            inner = td.finish_deferred(ok)
+            step.extend(
+                self._process_decrypt_step(epoch, proposer, inner)
+            )
+        return step
 
     def _process_subset_step(self, epoch: int, inner: Step) -> Step:
         step = inner.map(lambda m: SubsetWrap(epoch, m))
@@ -305,18 +370,74 @@ class HoneyBadger(ConsensusProtocol):
             step.output = []
             return step
         outputs = step.output
+        if not outputs:
+            # nothing accepted and Done unchanged → completion state
+            # cannot have moved: skip the per-message _try_complete scan
+            # (decryption progress runs its own completion check via
+            # _process_decrypt_step)
+            return step
         step.output = []
+        accepted = [
+            o for o in outputs if isinstance(o, subset_mod.Contribution)
+        ]
+        pre = self._precheck_accepted(accepted) if len(accepted) > 1 else {}
         for out in outputs:
             if isinstance(out, subset_mod.Contribution):
                 step.extend(
-                    self._on_accepted(epoch, out.proposer_id, out.value)
+                    self._on_accepted(epoch, out.proposer_id, out.value,
+                                      pre.get(out.proposer_id))
                 )
             elif isinstance(out, subset_mod.Done):
                 state.subset_done = True
         return step.extend(self._try_complete(epoch))
 
-    def _on_accepted(self, epoch: int, proposer_id: NodeId, payload: bytes) -> Step:
-        """An ACS-accepted contribution: plaintext or ciphertext to decrypt."""
+    def _precheck_accepted(self, accepted) -> Dict[NodeId, tuple]:
+        """Batch the crypto of several simultaneously ACS-accepted
+        ciphertexts: ONE merged CCA pairing check for all of them and ONE
+        batched call generating our decryption shares, instead of a
+        pairing + a scalar-mul per proposer.  Returns
+        ``{proposer: (ct, ok, share)}`` consumed by ``_on_accepted`` —
+        verdicts and shares are value-identical to the per-item path, so
+        behavior (and the simulator's byte-determinism) is unchanged."""
+        from hbbft_tpu.crypto.batch import (
+            batch_decrypt_share_gen,
+            verify_ciphertext_batch,
+        )
+
+        entries = []  # (proposer, ct)
+        for out in accepted:
+            payload = out.value
+            if not payload or payload[0] != _ENCRYPTED:
+                continue
+            try:
+                ct = tc.Ciphertext.from_bytes(payload[1:])
+            except (ValueError, IndexError):
+                continue
+            entries.append((out.proposer_id, ct))
+        if not entries:
+            return {}
+        oks = verify_ciphertext_batch([ct for _p, ct in entries])
+        shares: List[Any] = [None] * len(entries)
+        if self.netinfo.is_validator():
+            valid = [i for i, ok in enumerate(oks) if ok]
+            gen = batch_decrypt_share_gen(
+                self.netinfo.secret_key_share().scalar,
+                [entries[i][1] for i in valid],
+            )
+            for i, share in zip(valid, gen):
+                shares[i] = share
+        return {
+            p: (ct, ok, share)
+            for (p, ct), ok, share in zip(entries, oks, shares)
+        }
+
+    def _on_accepted(self, epoch: int, proposer_id: NodeId, payload: bytes,
+                     pre: Optional[tuple] = None) -> Step:
+        """An ACS-accepted contribution: plaintext or ciphertext to decrypt.
+
+        ``pre`` optionally carries this proposer's pre-batched
+        ``(ct, verify_ok, our_share)`` from :meth:`_precheck_accepted`.
+        """
         state = self.epochs[epoch]
         state.accepted.add(proposer_id)
         step = Step()
@@ -330,17 +451,21 @@ class HoneyBadger(ConsensusProtocol):
         if tag != _ENCRYPTED:
             state.excluded.add(proposer_id)
             return step.fault(proposer_id, FaultKind.InvalidCiphertext)
-        try:
-            ct = tc.Ciphertext.from_bytes(body)
-            ok = ct.verify()
-        except (ValueError, IndexError):
-            ok = False
+        share = None
+        if pre is not None:
+            ct, ok, share = pre
+        else:
+            try:
+                ct = tc.Ciphertext.from_bytes(body)
+                ok = ct.verify()
+            except (ValueError, IndexError):
+                ok = False
         if not ok:
             # all correct nodes agree (same RBC bytes) → consistent exclusion
             state.excluded.add(proposer_id)
             return step.fault(proposer_id, FaultKind.InvalidCiphertext)
         td = self._decrypt_for(state, proposer_id)
-        inner = td.set_ciphertext(ct)
+        inner = td.set_ciphertext(ct, share=share)
         return step.extend(self._process_decrypt_step(epoch, proposer_id, inner))
 
     def _process_decrypt_step(

@@ -73,7 +73,17 @@ class TransactionQueue:
             for t in drop:
                 self._set.pop(t, None)
 
-    def choose(self, rng: random.Random, amount: int) -> List[bytes]:
+    def choose(self, rng: random.Random, amount: int,
+               exclude: Optional[set] = None) -> List[bytes]:
+        """Sample ``amount`` txs; with ``exclude`` (the pipelined
+        proposer's in-flight set), sample only txs not already riding an
+        open epoch — a duplicate commit wastes a slot in BOTH epochs and
+        holds the client's latency to the later one."""
+        if exclude:
+            fresh = [t for t in self._txs if t not in exclude]
+            if amount >= len(fresh):
+                return fresh
+            return rng.sample(fresh, amount)
         if amount >= len(self._txs):
             return list(self._txs)
         return rng.sample(self._txs, amount)
@@ -122,6 +132,18 @@ class TxInput:
     tx: bytes
 
 
+@dataclass(frozen=True)
+class PipelineInput:
+    """Driver input: keep up to ``depth`` epochs proposed-into at once.
+
+    The epoch-pipelined node runtime feeds one per pump iteration; a
+    simulator can inject them between cranks to exercise the same
+    concurrency deterministically.  ``depth=1`` is a no-op (the normal
+    one-epoch-at-a-time proposal flow)."""
+
+    depth: int
+
+
 class QueueingHoneyBadgerBuilder:
     """Reference: ``queueing_honey_badger.rs :: QueueingHoneyBadgerBuilder``
     (batch_size + rng + queue knobs over a DynamicHoneyBadger)."""
@@ -168,6 +190,12 @@ class QueueingHoneyBadger(ConsensusProtocol):
         self.rng = rng or random.Random(0)
         self.queue = queue or TransactionQueue()
         self.dhb.empty_contribution = _ser_txs([])
+        # pipelined proposals only: txs we proposed into epochs that have
+        # not committed yet, keyed by (era, epoch) — propose_ahead samples
+        # around them so concurrent epochs carry disjoint fresh txs
+        # instead of duplicating in-flight ones (bounded by depth entries;
+        # commits and era rotations prune)
+        self._proposed: Dict[Tuple[int, int], Tuple[bytes, ...]] = {}
         # DHB's DKG keep-alive proposes REAL transactions, not empties
         self._install_provider()
 
@@ -179,6 +207,7 @@ class QueueingHoneyBadger(ConsensusProtocol):
     def __setstate__(self, state):
         # snapshot/restore: DHB drops the (unpicklable) provider closure
         self.__dict__.update(state)
+        self.__dict__.setdefault("_proposed", {})
         self._install_provider()
 
     @classmethod
@@ -199,6 +228,8 @@ class QueueingHoneyBadger(ConsensusProtocol):
         if isinstance(input, ChangeInput):
             step = self.dhb.vote_for(input.change)
             return step.extend(self._maybe_propose(force=True))
+        if isinstance(input, PipelineInput):
+            return self.propose_ahead(input.depth)
         raise TypeError(f"unknown QHB input {input!r}")
 
     def push_transaction(self, tx: bytes) -> Step:
@@ -210,13 +241,70 @@ class QueueingHoneyBadger(ConsensusProtocol):
         step = self._process(self.dhb.handle_message(sender_id, message))
         # if consensus activity exists for the current epoch and we haven't
         # proposed, contribute (possibly an empty sample) to keep it live
+        # (the has_input pre-check keeps the common already-proposed case
+        # allocation-free — _maybe_propose re-checks it authoritatively)
         if (
             isinstance(message, HbWrap)
             and message.era == self.dhb.era
+            and not self.dhb.hb.has_input.get(self.dhb.hb.epoch)
             and self.dhb.hb.epoch in self.dhb.hb.epochs
         ):
             step.extend(self._maybe_propose(force=True))
         return step
+
+    def propose_ahead(self, depth: int) -> Step:
+        """Epoch pipelining: sample and propose into every epoch in
+        ``[hb.epoch, hb.epoch + depth)`` that lacks our contribution, so
+        epoch e+1's RBC/ABA starts while epoch e threshold-decrypts.
+
+        Gated three ways: only with queued transactions (an idle cluster
+        must not spin empty epochs), only while no membership change is in
+        progress (a DKG rotation would orphan the future epochs' work),
+        and never past the protocol's ``max_future_epochs`` window.  A
+        transaction can be sampled into several in-flight epochs and then
+        commit more than once; duplicate commits are idempotent at every
+        consumer (queue pruning, mempool, client notification) — the
+        standard cost of pipelined HoneyBadger, paid for ~depth× epoch
+        concurrency."""
+        if depth <= 1 or not self.dhb.is_validator():
+            return Step()
+        if self.dhb.change_state.state != "none":
+            return Step()
+        step = Step()
+        for _ in range(depth):
+            hb = self.dhb.hb  # re-read: _process can advance/rotate it
+            base = hb.epoch
+            off = next(
+                (
+                    k for k in range(min(depth, hb.max_future_epochs + 1))
+                    if not hb.has_input.get(base + k)
+                ),
+                None,
+            )
+            if off is None or len(self.queue) == 0:
+                break
+            in_flight = (
+                {t for txs in self._proposed.values() for t in txs}
+                if self._proposed else None
+            )
+            sample = self.queue.choose(self.rng, self.batch_size,
+                                       exclude=in_flight)
+            if not sample:
+                # every queued tx already rides an open epoch: an empty
+                # filler proposal would spin cheap epochs that commit
+                # nothing — let the pipeline refill from fresh traffic
+                break
+            self._proposed[(self.dhb.era, base + off)] = tuple(sample)
+            step.extend(
+                self._process(self.dhb.propose_ahead(_ser_txs(sample), off))
+            )
+        return step
+
+    def has_deferred(self) -> bool:
+        return self.dhb.has_deferred()
+
+    def resolve_deferred(self) -> Step:
+        return self._process(self.dhb.resolve_deferred())
 
     # -- internals -----------------------------------------------------------
 
@@ -228,10 +316,19 @@ class QueueingHoneyBadger(ConsensusProtocol):
         if not force and len(self.queue) == 0:
             return Step()
         sample = self.queue.choose(self.rng, self.batch_size)
+        if sample:
+            # recorded for propose_ahead's exclusion only — the sequential
+            # path's own sampling is untouched (depth-1 determinism)
+            self._proposed[(self.dhb.era, self.dhb.hb.epoch)] = tuple(sample)
         return self._process(self.dhb.propose(_ser_txs(sample)))
 
     def _process(self, inner: Step) -> Step:
         """Decode DHB batches into tx batches and update the queue."""
+        if not inner.output:
+            # nothing to decode and nothing dropped: the common
+            # (mid-epoch) per-message case — pass the step through
+            # without re-allocating it
+            return inner
         step = Step(
             fault_log=inner.fault_log, messages=inner.messages
         )
@@ -251,6 +348,11 @@ class QueueingHoneyBadger(ConsensusProtocol):
                 contribs.append((proposer, txs))
                 committed.extend(txs)
             self.queue.remove_multiple(committed)
+            # this epoch's proposal landed (and any stale older-era /
+            # older-epoch records with it): stop excluding its txs
+            for k in [k for k in self._proposed
+                      if k <= (out.era, out.epoch)]:
+                del self._proposed[k]
             step.output.append(
                 QhbBatch(
                     era=out.era,

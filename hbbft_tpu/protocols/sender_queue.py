@@ -94,6 +94,12 @@ class SenderQueue(ConsensusProtocol):
         # per-peer buffered (key, message)
         self.buffered: Dict[NodeId, List[Tuple[EpochKey, Any]]] = {}
         self.last_announced: Optional[EpochKey] = None
+        # _known_peers runs once per posted Step (hot path): cache the
+        # sorted peer list, keyed on what can change it — a new peer in
+        # peer_epochs or a fresh NetworkInfo after an era rotation
+        self._peers_cache: Optional[List[NodeId]] = None
+        self._peers_not_us: List[NodeId] = []
+        self._peers_cache_key: Tuple[Any, int] = (None, -1)
 
     def startup_step(self) -> Step:
         """Announce our epoch so peers learn we exist.
@@ -124,6 +130,22 @@ class SenderQueue(ConsensusProtocol):
         if isinstance(message, AlgoMessage):
             return self._post(self.algo.handle_message(sender_id, message.msg))
         raise TypeError(f"unknown sender_queue message {message!r}")
+
+    # -- pipelined-runtime passthroughs --------------------------------------
+
+    def has_deferred(self) -> bool:
+        """Whether the wrapped algorithm parked deferred crypto work."""
+        probe = getattr(self.algo, "has_deferred", None)
+        return bool(probe()) if probe is not None else False
+
+    def resolve_deferred(self) -> Step:
+        """Drain the wrapped algorithm's deferred crypto (batched share
+        verification), with the usual epoch-gated buffering applied to
+        whatever messages the resolution emits."""
+        resolver = getattr(self.algo, "resolve_deferred", None)
+        if resolver is None:
+            return Step()
+        return self._post(resolver())
 
     # -- internals -----------------------------------------------------------
 
@@ -206,20 +228,41 @@ class SenderQueue(ConsensusProtocol):
 
     def _post(self, inner: Step) -> Step:
         """Wrap outgoing messages, buffering ones their target can't use yet,
-        and announce our own epoch transitions."""
+        and announce our own epoch transitions.
+
+        Deliverable recipients of one message share ONE ``AlgoMessage`` /
+        ``TargetedMessage`` pair (a multi-node target) instead of a
+        per-peer triple — the runtime's ``_dispatch`` resolves targets and
+        already encodes per unique inner message, so per-peer wrapping
+        only allocated; it never changed what went on the wire."""
         step = Step(output=inner.output, fault_log=inner.fault_log)
-        peers = [n for n in self._known_peers() if n != self.our_id()]
+        self._known_peers()  # refresh the cache pair
+        peers = self._peers_not_us
+        window = _algo_window(self.algo)
+        peer_epochs = self.peer_epochs
         for tm in inner.messages:
             key = message_key(tm.message)
+            target = tm.target
+            ready: Optional[List[NodeId]] = None
             for peer in peers:
-                if not tm.target.contains(peer):
+                if not target.contains(peer):
                     continue
-                if self._deliverable(key, peer):
-                    step.send_to(peer, AlgoMessage(tm.message))
+                era, epoch = peer_epochs.get(peer, (0, 0))
+                if key <= (era, epoch + window):
+                    if ready is None:
+                        ready = []
+                    ready.append(peer)
                 else:
                     self.buffered.setdefault(peer, []).append(
                         (key, tm.message)
                     )
+            if ready is not None:
+                # ALWAYS an explicit node set — never Target.all(): the
+                # driver resolves all() against ITS OWN membership view
+                # (transport peers / every sim node), which may exceed
+                # _known_peers and would bypass the per-peer epoch-gated
+                # buffering this wrapper exists to enforce
+                step.send(Target.nodes(ready), AlgoMessage(tm.message))
         cur = _algo_key(self.algo)
         if self.last_announced is None or cur > self.last_announced:
             self.last_announced = cur
@@ -232,5 +275,14 @@ class SenderQueue(ConsensusProtocol):
             if isinstance(self.algo, QueueingHoneyBadger)
             else self.algo.netinfo
         )
-        known = set(netinfo.all_ids()) | set(self.peer_epochs.keys())
-        return sorted(known, key=repr)
+        # the cached netinfo is held by strong reference, so an `is`
+        # check can never be fooled by id reuse after an era rotation
+        cached_ni, cached_n = self._peers_cache_key
+        if (self._peers_cache is None or cached_ni is not netinfo
+                or cached_n != len(self.peer_epochs)):
+            known = set(netinfo.all_ids()) | set(self.peer_epochs.keys())
+            self._peers_cache = sorted(known, key=repr)
+            us = self.our_id()
+            self._peers_not_us = [n for n in self._peers_cache if n != us]
+            self._peers_cache_key = (netinfo, len(self.peer_epochs))
+        return self._peers_cache

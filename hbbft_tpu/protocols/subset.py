@@ -144,15 +144,22 @@ class Subset(ConsensusProtocol):
         step = inner.map(lambda m: BroadcastWrap(proposer_id, m))
         values = step.output
         step.output = []
+        changed = False
         for value in values:
             if prop.value is None:
                 prop.value = value
+                changed = True
                 # RBC delivered → vote to accept this proposal
                 if prop.decision is None and prop.agreement.estimate is None:
                     ba_step = prop.agreement.handle_input(True)
                     step.extend(
                         self._process_agreement_step(proposer_id, ba_step)
                     )
+        if not changed:
+            # no new delivery → emission/threshold/Done state cannot have
+            # moved: skip the all-proposals _try_progress scan (it runs
+            # once per consensus message otherwise)
+            return step
         return step.extend(self._try_progress())
 
     def _process_agreement_step(self, proposer_id: NodeId, inner: Step) -> Step:
@@ -160,9 +167,13 @@ class Subset(ConsensusProtocol):
         step = inner.map(lambda m: AgreementWrap(proposer_id, m))
         decisions = step.output
         step.output = []
+        changed = False
         for d in decisions:
             if prop.decision is None:
                 prop.decision = bool(d)
+                changed = True
+        if not changed:
+            return step
         return step.extend(self._try_progress())
 
     def _count_true(self) -> int:

@@ -43,6 +43,15 @@ class ThresholdDecrypt(ConsensusProtocol):
         self.pending: Dict[NodeId, tc.DecryptionShare] = {}
         self.plaintext: Optional[bytes] = None
         self.had_input = False
+        # Deferred-verification hook (the epoch-pipelined runtime's seam):
+        # when set, reaching t+1 shares does NOT verify inline — the chosen
+        # share set is parked and ``defer_verify(self)`` registers this
+        # instance with the caller, who verifies MANY instances (across the
+        # epochs in flight) in one merged pairing-product call and resumes
+        # each via :meth:`finish_deferred`.  None (the default) keeps the
+        # reference-exact inline behavior — the simulator path.
+        self.defer_verify = None
+        self._deferred_items = None
 
     def our_id(self) -> NodeId:
         return self.netinfo.our_id()
@@ -52,11 +61,15 @@ class ThresholdDecrypt(ConsensusProtocol):
 
     # -- API ----------------------------------------------------------------
 
-    def set_ciphertext(self, ct: tc.Ciphertext) -> Step:
+    def set_ciphertext(self, ct: tc.Ciphertext,
+                       share: Optional[tc.DecryptionShare] = None) -> Step:
         """Set the ciphertext, emit our share, process buffered shares.
 
         The caller must have validated ``ct`` (``Ciphertext.verify``) —
         HoneyBadger does this when accepting a subset contribution.
+        ``share`` may carry our own pre-computed decryption share (the
+        batched generation path, ``crypto.batch.batch_decrypt_share_gen``);
+        it must equal what ``decrypt_share(ct, check=False)`` returns.
         """
         if self.ciphertext is not None:
             return Step()
@@ -64,10 +77,12 @@ class ThresholdDecrypt(ConsensusProtocol):
         step = Step()
         if self.netinfo.is_validator():
             self.had_input = True
-            # check=False: HoneyBadger validates the ciphertext on acceptance
-            share = self.netinfo.secret_key_share().decrypt_share(
-                ct, check=False
-            )
+            if share is None:
+                # check=False: HoneyBadger validates the ciphertext on
+                # acceptance
+                share = self.netinfo.secret_key_share().decrypt_share(
+                    ct, check=False
+                )
             step.send_all(DecryptionMessage(share))
             step.extend(self._handle_share(self.our_id(), share))
         pending, self.pending = self.pending, {}
@@ -108,7 +123,11 @@ class ThresholdDecrypt(ConsensusProtocol):
 
     def _batch_verify(self, items) -> bool:
         """One pairing-check for many shares via a hash-derived random
-        linear combination (soundness error ~2^-255)."""
+        linear combination (soundness error ~2^-255).  The two MSM folds
+        route through :func:`hbbft_tpu.crypto.batch.rlc_fold_g1` — host
+        asm at coin-sized batches, device ladders past the crossover."""
+        from hbbft_tpu.crypto.batch import rlc_fold_g1
+
         ct = self.ciphertext
         h = tc._hash_ciphertext_point(ct.u, ct.v)
         seed = hashlib.sha3_256(
@@ -116,21 +135,49 @@ class ThresholdDecrypt(ConsensusProtocol):
             + ct.to_bytes()
             + b"".join(s.to_bytes() for _, s in items)
         ).digest()
-        acc_share = None
-        acc_pk = None
-        for k, (idx, share) in enumerate(items):
-            rho = (
-                int.from_bytes(
-                    hashlib.sha3_256(seed + k.to_bytes(4, "big")).digest(), "big"
-                )
-                % bls.R
+        rhos = [
+            int.from_bytes(
+                hashlib.sha3_256(seed + k.to_bytes(4, "big")).digest(),
+                "big",
             )
-            acc_share = bls.g1_add(acc_share, bls.g1_mul(share.point, rho))
-            pk_i = self.netinfo.public_key_set().public_key_share(idx)
-            acc_pk = bls.g1_add(acc_pk, bls.g1_mul(pk_i.point, rho))
+            % bls.R
+            for k in range(len(items))
+        ]
+        pks = self.netinfo.public_key_set()
+        acc_share = rlc_fold_g1([s.point for _, s in items], rhos)
+        acc_pk = rlc_fold_g1(
+            [pks.public_key_share(idx).point for idx, _ in items], rhos
+        )
         return bls.pairing_check(
             [(bls.g1_neg(acc_share), h), (acc_pk, ct.w)]
         )
+
+    def deferred_job(self):
+        """``(items, ciphertext)`` of the parked verification, or None."""
+        if self._deferred_items is None:
+            return None
+        return self._deferred_items, self.ciphertext
+
+    def finish_deferred(self, ok: bool) -> Step:
+        """Resume a deferred verification with the batch verdict.
+
+        ``ok=True`` decrypts from the parked share set (exactly what the
+        inline path would have done); ``ok=False`` re-runs the full inline
+        path — per-share blame fallback included — so fault attribution is
+        identical to the undeferred protocol."""
+        items, self._deferred_items = self._deferred_items, None
+        if (items is None or self.plaintext is not None
+                or self.ciphertext is None):
+            return Step()
+        if ok:
+            pks = self.netinfo.public_key_set()
+            self.plaintext = pks.decrypt(dict(items), self.ciphertext)
+            return Step.from_output(self.plaintext)
+        defer, self.defer_verify = self.defer_verify, None
+        try:
+            return self._try_output()
+        finally:
+            self.defer_verify = defer
 
     def _try_output(self) -> Step:
         pks = self.netinfo.public_key_set()
@@ -139,6 +186,11 @@ class ThresholdDecrypt(ConsensusProtocol):
             return Step()
         chosen = sorted(self.shares.items(), key=lambda kv: repr(kv[0]))[: t + 1]
         items = [(self.netinfo.node_index(nid), s) for nid, s in chosen]
+        if self.defer_verify is not None:
+            if self._deferred_items is None:
+                self._deferred_items = items
+                self.defer_verify(self)
+            return Step()
         if self._batch_verify(items):
             plaintext = pks.decrypt(dict(items), self.ciphertext)
             self.plaintext = plaintext

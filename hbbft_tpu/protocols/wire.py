@@ -35,11 +35,14 @@ MAX_MESSAGE_BYTES = MAX_BLOB_BYTES + 4096
 
 
 class Reader:
+    __slots__ = ("data", "pos", "max_blob", "_depth")
+
     def __init__(self, data: bytes, max_blob: Optional[int] = None):
         self.data = data
         self.pos = 0
         # resolved at call time so deployments can raise the module knob
         self.max_blob = MAX_BLOB_BYTES if max_blob is None else max_blob
+        self._depth = 0
 
     def take(self, n: int) -> bytes:
         if n < 0:
@@ -54,10 +57,26 @@ class Reader:
         return out
 
     def u32(self) -> int:
-        return struct.unpack(">I", self.take(4))[0]
+        # unpack_from avoids the take() slice copy — u32/u64 run several
+        # times per decoded message on the runtime's hot path
+        pos = self.pos
+        if pos + 4 > len(self.data):
+            raise ValueError(
+                f"truncated: need 4 bytes at offset {pos}, "
+                f"have {len(self.data) - pos}"
+            )
+        self.pos = pos + 4
+        return struct.unpack_from(">I", self.data, pos)[0]
 
     def u64(self) -> int:
-        return struct.unpack(">Q", self.take(8))[0]
+        pos = self.pos
+        if pos + 8 > len(self.data):
+            raise ValueError(
+                f"truncated: need 8 bytes at offset {pos}, "
+                f"have {len(self.data) - pos}"
+            )
+        self.pos = pos + 8
+        return struct.unpack_from(">Q", self.data, pos)[0]
 
     def blob(self) -> bytes:
         n = self.u32()
@@ -252,19 +271,26 @@ _MAX_NESTING = 8
 
 
 def _read_message(r: Reader):
-    depth = getattr(r, "_depth", 0)
+    # hand-inlined tag read + explicit depth bookkeeping (no try/finally,
+    # no getattr): this function runs once per nesting level of every
+    # message on the runtime's hot path.  On a decode error the Reader is
+    # abandoned whole, so the depth only needs restoring on success.
+    depth = r._depth
     if depth >= _MAX_NESTING:
         raise ValueError("message nesting too deep")
+    pos = r.pos
+    data = r.data
+    if pos >= len(data):
+        raise ValueError(f"truncated: need 1 byte at offset {pos}, have 0")
+    tag = data[pos]
+    r.pos = pos + 1
+    dec = _MSG_DECODERS.get(tag)
+    if dec is None:
+        raise ValueError(f"unknown message tag 0x{tag:02x}")
     r._depth = depth + 1
-    try:
-        tag = r.take(1)[0]
-        try:
-            dec = _MSG_DECODERS[tag]
-        except KeyError:
-            raise ValueError(f"unknown message tag 0x{tag:02x}")
-        return dec(r)
-    finally:
-        r._depth = depth
+    msg = dec(r)
+    r._depth = depth
+    return msg
 
 
 def _lazy_register():

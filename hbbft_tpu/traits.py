@@ -106,7 +106,7 @@ class Target:
         return f"Target.nodes({sorted(self.ids, key=repr)!r})"
 
 
-@dataclass
+@dataclass(slots=True)
 class TargetedMessage(Generic[M]):
     """A message plus its routing directive.
 
@@ -120,7 +120,7 @@ class TargetedMessage(Generic[M]):
         return TargetedMessage(self.target, f(self.message))
 
 
-@dataclass
+@dataclass(slots=True)
 class Step(Generic[M, O]):
     """The result of handling one input or message.
 
@@ -161,16 +161,20 @@ class Step(Generic[M, O]):
         msg_f: Callable[[M], Any],
         out_f: Optional[Callable[[O], Any]] = None,
     ) -> "Step":
-        """Return a new Step with messages (and optionally outputs) rewrapped.
+        """Rewrap messages (and optionally outputs) IN PLACE, returning
+        ``self``.
 
-        This is how an outer protocol lifts an inner protocol's step into its
-        own message/output types (reference ``Step::map``).
+        This is how an outer protocol lifts an inner protocol's step into
+        its own message/output types (reference ``Step::map``).  The
+        receiver is CONSUMED: every call site discards it in favor of the
+        result, and the QHB wrapper chain maps each step three times per
+        message — copying output/fault/message lists at every layer was a
+        measurable slice of the per-message hot path.
         """
-        return Step(
-            output=[out_f(o) for o in self.output] if out_f else list(self.output),
-            fault_log=FaultLog(list(self.fault_log.faults)),
-            messages=[tm.map(msg_f) for tm in self.messages],
-        )
+        if out_f:
+            self.output = [out_f(o) for o in self.output]
+        self.messages = [tm.map(msg_f) for tm in self.messages]
+        return self
 
     def send(self, target: Target, message: M) -> "Step":
         self.messages.append(TargetedMessage(target, message))

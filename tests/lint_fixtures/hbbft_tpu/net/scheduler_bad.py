@@ -1,0 +1,19 @@
+"""Fixture: direct threshold crypto inside a net scheduler module."""
+
+from hbbft_tpu.crypto import bls12_381 as bls
+
+
+class Pump:
+    def __init__(self, netinfo, ct):
+        self.netinfo = netinfo
+        self.ct = ct
+
+    def process(self, pairs, share):
+        # BAD: pairing product evaluated directly in the scheduler
+        ok = bls.pairing_check(pairs)
+        # BAD: per-message share verification bypassing the batched path
+        self.netinfo.public_key_set().public_key_share(0).\
+            verify_decryption_share(share, self.ct)
+        # BAD: inline share generation
+        self.netinfo.secret_key_share().decrypt_share(self.ct)
+        return ok

@@ -1,0 +1,14 @@
+"""Fixture: a scheduler that delegates crypto the sanctioned way."""
+
+
+class Pump:
+    def __init__(self, runtime):
+        self.runtime = runtime
+
+    def process(self, events, depth):
+        # crypto flows through the protocols' deferred-resolution
+        # surface; the scheduler only sequences it
+        outcome = self.runtime.pump_process(events, depth)
+        while self.runtime.sq.has_deferred():
+            self.runtime.sq.resolve_deferred()
+        return outcome

@@ -104,3 +104,23 @@ def test_real_recorded_trajectory_files_compare():
     report = bench.compare_bench(old, new, threshold=0.5)
     names = {c["name"] for c in report["checks"]}
     assert "value" in names and "p50_latency_ms" in names
+
+
+def test_depth_change_skips_per_epoch_metrics():
+    """Epoch-wall / phase-attribution metrics measure a different
+    quantity once epochs overlap: a depth-1 → depth-4 comparison must
+    gate on throughput and client latency only (pipelining stretches
+    every per-epoch wall by design), while an equal-depth comparison
+    still gates on them."""
+    old = _line()                       # no pipeline_depth key → depth 1
+    new = _line(value=40.0, wall=80.0)  # wall doubled, throughput doubled
+    new["pipeline_depth"] = 4
+    report = bench.compare_bench(old, new, threshold=0.15)
+    assert report["ok"] and not report["epoch_metrics_compared"]
+    names = {c["name"] for c in report["checks"]}
+    assert "phases.epoch_wall_p50_ms" not in names
+
+    same = _line(value=40.0, wall=80.0)  # same depth: wall gate applies
+    report = bench.compare_bench(old, same, threshold=0.15)
+    assert report["epoch_metrics_compared"]
+    assert "phases.epoch_wall_p50_ms" in report["regressions"]

@@ -65,6 +65,20 @@ def test_asyncio_fixture():
     assert fired(AsyncioHazardChecker(), "async_good.py") == []
 
 
+def test_pump_inline_crypto_fixture():
+    # the scheduler module must stay crypto-free: direct pairing/share
+    # calls bypass the batched executor path the pump exists to provide
+    rules = fired(AsyncioHazardChecker(),
+                  "hbbft_tpu/net/scheduler_bad.py")
+    assert rules.count("pump-inline-crypto") == 3
+    assert fired(AsyncioHazardChecker(),
+                 "hbbft_tpu/net/scheduler_good.py") == []
+    # and the rule scopes to scheduler modules only: the same calls in a
+    # generic net module are not its business (async rules still apply)
+    assert "pump-inline-crypto" not in fired(
+        AsyncioHazardChecker(), "async_bad.py")
+
+
 def test_fault_accounting_fixture():
     # the drop rule self-scopes to hbbft_tpu/net/ paths, so the fault
     # fixtures live under that relative path inside the fixture root

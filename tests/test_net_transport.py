@@ -198,7 +198,9 @@ def test_replay_prune_survives_era_boundary():
     cfg = ClusterConfig(n=4, seed=55)
     rt = build_runtime(cfg, generate_infos(cfg), 0)
     retain = rt.replay_retain_epochs
-    entries = [((0, 58), "a"), ((0, 63), "b"), ((1, 0), "c")]
+    # replay entries are (key, message, payload-bytes) triples
+    entries = [((0, 58), "a", b"a"), ((0, 63), "b", b"b"),
+               ((1, 0), "c", b"c")]
     # young era 1: previous era's tail is retained
     rt._replay = {1: list(entries)}
     rt.current_key = lambda: (1, 2)
@@ -211,10 +213,11 @@ def test_replay_prune_survives_era_boundary():
     rt._prune_replay()
     assert rt._replay[1] == []
     # same-era pruning unchanged
-    rt._replay = {1: [((0, 1), "old"), ((0, retain + 3), "new")]}
+    rt._replay = {1: [((0, 1), "old", b"o"),
+                      ((0, retain + 3), "new", b"n")]}
     rt.current_key = lambda: (0, retain + 5)
     rt._prune_replay()
-    assert rt._replay[1] == [((0, retain + 3), "new")]
+    assert rt._replay[1] == [((0, retain + 3), "new", b"n")]
 
 
 def test_client_fails_fast_on_corrupt_stream():
