@@ -1570,7 +1570,10 @@ def compare_bench(old, new, threshold: float = 0.15,
         })
 
     unit = str(old.get("unit", ""))
-    add("value", unit.endswith("/s"), threshold)
+    # rates and the chaos campaign's clean fraction are higher-better;
+    # latencies/durations below are lower-better
+    add("value", unit.endswith("/s") or unit == "clean_fraction",
+        threshold)
     for lat in ("p50_latency_ms", "p99_latency_ms"):
         add(lat, False, threshold)
     # Per-EPOCH duration metrics (epoch wall, phase attribution) compare

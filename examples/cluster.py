@@ -92,7 +92,21 @@ def main() -> None:
     ap.add_argument("--pipeline-depth", type=int, default=1,
                     help="epochs kept in flight per node (1 = sequential; "
                          "> 1 engages the epoch-pipelined scheduler)")
+    ap.add_argument("--chaos", default="", metavar="SPEC",
+                    help="apply a named link-shaping preset to every "
+                         "node's egress (wan-100ms, lossy-1pct, "
+                         "dup-reorder, partition-10s, bandwidth-64k) — "
+                         "reproduce a campaign cell interactively")
+    ap.add_argument("--chaos-seed", type=int, default=-1,
+                    help="seed for the chaos fault RNGs (-1 = --seed); "
+                         "pass a campaign cell's reported seed to replay "
+                         "its fault schedule")
     args = ap.parse_args()
+    if args.chaos:
+        # validate the preset name before spawning anything
+        from hbbft_tpu.chaos.link import preset_shape
+
+        preset_shape(args.chaos, args.nodes)
 
     if args.base_port:
         base = args.base_port
@@ -119,9 +133,16 @@ def main() -> None:
         metrics_base_port=metrics_base,
         batch_size=args.batch_size, encrypt=args.encrypt,
         flight_dir=flight_dir, pipeline_depth=args.pipeline_depth,
+        chaos=args.chaos, chaos_seed=args.chaos_seed,
     )
     print(f"spawning {cfg.n} node processes on "
           f"{cfg.host}:{cfg.base_port}..{cfg.base_port + cfg.n - 1}…")
+    if cfg.chaos:
+        seed = cfg.seed if cfg.chaos_seed < 0 else cfg.chaos_seed
+        print(f"chaos preset {cfg.chaos!r} active on every link "
+              f"(fault seed {seed}) — expect shaped latency/faults; "
+              f"shaping counters are on each node's /metrics "
+              f"(hbbft_chaos_*)")
     if metrics_base:
         print(f"obs endpoints: http://{cfg.host}:{metrics_base}.."
               f"{metrics_base + cfg.n - 1}/metrics — watch live with\n"

@@ -1,0 +1,34 @@
+"""Chaos engineering for the hbbft-tpu stack (ROADMAP Open item 4).
+
+Two pieces:
+
+- :mod:`hbbft_tpu.chaos.link` — the pluggable link-shaping layer: seeded,
+  per-directed-edge :class:`LinkPolicy` decisions (latency/jitter, loss,
+  duplication, reorder, bandwidth caps, timed partitions/heals) behind ONE
+  shaping hook (:class:`LinkShaper`) consumed by *both* the deterministic
+  simulator (``sim/virtual_net.py``) and the real socket transport
+  (``net/transport.py``);
+- :mod:`hbbft_tpu.chaos.campaign` — the campaign runner: hundreds of
+  seeded (scenario × topology × adversary) cells per invocation, every
+  cell's flight journals audited by :mod:`hbbft_tpu.obs.audit`, every
+  non-clean verdict auto-triaged to its first divergent epoch with the
+  seed + scenario spec needed to replay it deterministically.
+
+This package sits inside hblint's ``determinism`` scope: every shaping
+decision must come from the seeded RNG (no wall-clock reads, no global
+randomness) — the same run replays byte-identically.
+"""
+
+from hbbft_tpu.chaos.link import (
+    LinkPolicy,
+    LinkShaper,
+    NetShape,
+    PRESETS,
+    ShapedLink,
+    preset_shape,
+)
+
+__all__ = [
+    "LinkPolicy", "LinkShaper", "NetShape", "PRESETS", "ShapedLink",
+    "preset_shape",
+]

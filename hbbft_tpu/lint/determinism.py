@@ -2,7 +2,10 @@
 
 Every honest replica must fold the same inputs into the same Steps and the
 same ledger, so code under ``protocols/``, ``parallel/`` and ``crypto/``
-must not consult ambient nondeterminism:
+must not consult ambient nondeterminism — and ``chaos/`` joins the scope
+because a chaos campaign cell must replay byte-identically from its seed
+(a shaping decision drawn from wall time or the global RNG would make
+every triaged failure unreproducible):
 
 - ``det-wall-clock`` — wall-clock reads (``time.time``, ``time.monotonic``,
   ``datetime.now`` …).  Timing belongs to the drivers (net/, sim/, obs/),
@@ -111,8 +114,11 @@ def _call_name(node: ast.Call) -> str:
 @register
 class DeterminismChecker(Checker):
     name = "determinism"
+    # chaos/ is in scope since the campaign runner: shaping decisions
+    # and scenario schedules must come from the seeded RNG, or the
+    # campaign's byte-identical-replay guarantee is fiction
     scope = ("hbbft_tpu/protocols/", "hbbft_tpu/parallel/",
-             "hbbft_tpu/crypto/")
+             "hbbft_tpu/crypto/", "hbbft_tpu/chaos/")
     rules = {
         "det-wall-clock":
             "wall-clock read in consensus-core code (time.time, "

@@ -17,7 +17,10 @@ a swallowed exception is an invisible Byzantine symptom.
   ``/metrics``.  ``obs/`` is in scope since the flight recorder: a
   journal that silently drops records on disk errors is a black box that
   lies, so its failure paths must count
-  ``hbbft_obs_flight_write_failures_total`` (and friends).
+  ``hbbft_obs_flight_write_failures_total`` (and friends).  ``chaos/``
+  is in scope since the campaign runner: shaped-away frames must count
+  ``hbbft_chaos_frames_dropped_total`` and a failed cell must land in
+  the report's error tally, never vanish.
 """
 
 from __future__ import annotations
@@ -88,9 +91,11 @@ class FaultAccountingChecker(Checker):
             "record_*/backoff call)",
     }
 
-    #: the drop rule only applies here — peer/client input paths, and
-    #: the flight recorder's journal-durability paths
-    DROP_SCOPE = ("hbbft_tpu/net/", "hbbft_tpu/obs/")
+    #: the drop rule only applies here — peer/client input paths, the
+    #: flight recorder's journal-durability paths, and the chaos layer
+    #: (a shaped/dropped frame the campaign can't account for would
+    #: corrupt every liveness number the report emits)
+    DROP_SCOPE = ("hbbft_tpu/net/", "hbbft_tpu/obs/", "hbbft_tpu/chaos/")
 
     def check_module(self, mod: ModuleSource) -> Iterable[Finding]:
         tree = mod.tree

@@ -74,6 +74,14 @@ class ClusterConfig:
     # outbound link shaping, "SRC>DST:SECONDS,…" (e.g. "3>0:0.02,3>1:0.02"
     # delays node 3's frames to nodes 0 and 1 by 20 ms); "" → no shaping
     link_delays: str = ""
+    # named chaos preset (chaos.link.preset_shape: wan-100ms, lossy-1pct,
+    # dup-reorder, partition-10s, bandwidth-64k) applied to every node's
+    # egress through the shared LinkShaper hook; "" → no chaos shaping.
+    # chaos_seed seeds the per-edge fault RNGs (-1 → the cluster seed):
+    # same config, same seed, same faults — a campaign cell's scenario
+    # is reproducible interactively (examples/cluster.py --chaos)
+    chaos: str = ""
+    chaos_seed: int = -1
     # slow-node shaping: node `slow_node` sleeps `slow_delay_s` before
     # every pump iteration (an overloaded validator) — the bench's
     # coin-exercise knob; -1 → nobody is slowed
@@ -128,6 +136,17 @@ class ClusterConfig:
                 if int(node) == nid:
                     return float(secs)
         return self.slow_delay_s if nid == self.slow_node else 0.0
+
+    def chaos_shaper_for(self, nid: int):
+        """This node's LinkShaper under the configured chaos preset (one
+        shaper per transport; the seed is shared so every node draws the
+        same per-edge fault streams)."""
+        if not self.chaos or self.chaos == "none":
+            return None
+        from hbbft_tpu.chaos.link import LinkShaper, preset_shape
+
+        seed = self.seed if self.chaos_seed < 0 else self.chaos_seed
+        return LinkShaper(preset_shape(self.chaos, self.n), seed=seed)
 
     def aba_delay_for(self, nid: int) -> float:
         """This node's outbound ABA-class hold, from aba_delay_nodes."""
@@ -188,6 +207,7 @@ def build_algo(cfg: ClusterConfig, infos: Dict[int, NetworkInfo],
 
 def build_runtime(cfg: ClusterConfig, infos: Dict[int, NetworkInfo],
                   nid: int, **kwargs) -> NodeRuntime:
+    kwargs.setdefault("shaper", cfg.chaos_shaper_for(nid))
     return NodeRuntime(
         build_algo(cfg, infos, nid),
         cfg.cluster_id,
@@ -221,15 +241,22 @@ class LocalCluster:
         self.addrs: Dict[int, Addr] = {}
         self.metrics_addrs: Dict[int, Addr] = {}
         self._clients: List[ClusterClient] = []
+        self._infos: Dict[int, NetworkInfo] = {}
 
     async def start(self) -> None:
-        infos = generate_infos(self.cfg)
+        self._infos = generate_infos(self.cfg)
         self.runtimes = [
-            build_runtime(self.cfg, infos, nid, **self.runtime_kwargs)
+            build_runtime(self.cfg, self._infos, nid,
+                          **self.runtime_kwargs)
             for nid in range(self.cfg.n)
         ]
         for nid, rt in enumerate(self.runtimes):
-            self.addrs[nid] = await rt.start(self.cfg.host, 0)
+            # base_port set → fixed addresses (restart_node can rebind);
+            # 0 → ephemeral as before
+            self.addrs[nid] = await rt.start(
+                self.cfg.host,
+                self.cfg.base_port + nid if self.cfg.base_port else 0,
+            )
             self.metrics_addrs[nid] = await rt.start_obs(
                 self.cfg.host,
                 (self.cfg.metrics_base_port + nid
@@ -243,6 +270,28 @@ class LocalCluster:
             await client.close()
         for rt in self.runtimes:
             await rt.stop()
+
+    async def restart_node(self, nid: int) -> None:
+        """Kill/restart churn primitive: stop runtime ``nid`` and rebuild
+        it from scratch at (0, 0) on its old address (requires fixed
+        ports, i.e. ``cfg.base_port``).  Peers' senders keep dialing the
+        address and the fresh hello triggers the SenderQueue replay
+        catch-up; with a flight dir the journal's incarnation bumps, so
+        the restart is visible to the auditor."""
+        if not self.cfg.base_port:
+            raise ValueError("restart_node needs fixed ports "
+                             "(ClusterConfig.base_port)")
+        await self.runtimes[nid].stop()
+        rt = build_runtime(self.cfg, self._infos, nid,
+                           **self.runtime_kwargs)
+        self.runtimes[nid] = rt
+        await rt.start(self.cfg.host, self.cfg.base_port + nid)
+        self.metrics_addrs[nid] = await rt.start_obs(
+            self.cfg.host,
+            (self.cfg.metrics_base_port + nid
+             if self.cfg.metrics_base_port else 0),
+        )
+        rt.connect(self.addrs)
 
     async def client(self, nid: int,
                      client_id: str = "client") -> ClusterClient:
@@ -345,6 +394,10 @@ def node_command(cfg: ClusterConfig, nid: int) -> List[str]:
         cmd += ["--pipeline-depth", str(cfg.pipeline_depth)]
     if cfg.link_delays:
         cmd += ["--link-delays", cfg.link_delays]
+    if cfg.chaos:
+        cmd += ["--chaos", cfg.chaos]
+        if cfg.chaos_seed >= 0:
+            cmd += ["--chaos-seed", str(cfg.chaos_seed)]
     if cfg.step_delay_for(nid) > 0:
         cmd += ["--step-delay", str(cfg.step_delay_for(nid))]
     if cfg.aba_delay_for(nid) > 0:
@@ -501,6 +554,13 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--link-delays", default="",
                     help="outbound link shaping, SRC>DST:SECONDS[,…] "
                          "(only entries whose SRC is this node apply)")
+    ap.add_argument("--chaos", default="",
+                    help="named chaos link-shaping preset (wan-100ms, "
+                         "lossy-1pct, dup-reorder, partition-10s, "
+                         "bandwidth-64k); empty = off")
+    ap.add_argument("--chaos-seed", type=int, default=-1,
+                    help="seed for the chaos fault RNGs "
+                         "(-1 = the cluster seed)")
     ap.add_argument("--step-delay", type=float, default=0.0,
                     help="sleep SECONDS before every pump iteration "
                          "(slow-node chaos shaping)")
@@ -518,6 +578,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         batch_size=args.batch_size, encrypt=args.encrypt,
         flight_dir=args.flight_dir, pipeline_depth=args.pipeline_depth,
         link_delays=args.link_delays,
+        chaos=args.chaos, chaos_seed=args.chaos_seed,
         slow_node=(args.node_id if args.step_delay > 0 else -1),
         slow_delay_s=args.step_delay,
         aba_delay_nodes=(str(args.node_id) if args.aba_out_delay > 0

@@ -320,13 +320,7 @@ class _PeerSender:
         self.t = transport
         self.peer_id = peer_id
         self.addr = addr
-        # entries are (ready_at, frame): ready_at is 0.0 on an unshaped
-        # link; with a link_delays entry it is enqueue time + delay — the
-        # drainer holds frames back until they are "due", modeling link
-        # latency without serializing throughput (scenario shaping for
-        # the bench/chaos harnesses)
-        self.delay = float(transport.link_delays.get(peer_id, 0.0))
-        self.outbox: Deque[Tuple[float, bytes]] = deque()
+        self.outbox: Deque[bytes] = deque()
         self.wake = asyncio.Event()
         self.connected = asyncio.Event()
         self.stopped = False
@@ -341,8 +335,30 @@ class _PeerSender:
         )
 
     def send(self, frame: bytes) -> None:
-        ready = time.monotonic() + self.delay if self.delay > 0 else 0.0
-        self.outbox.append((ready, frame))
+        # the transport side of the shared shaping hook
+        # (chaos.link.LinkShaper): per-edge latency/jitter/loss/dup/
+        # bandwidth/partition decisions, seeded and accounted.  Shaped
+        # copies are scheduled onto the event loop; a dropped frame was
+        # already counted by the shaper (hbbft_chaos_frames_dropped_total)
+        shaper = self.t.shaper
+        if shaper is not None:
+            delays = shaper.shape_frame(
+                self.t.our_id, self.peer_id, self.t.chaos_now(),
+                nbytes=len(frame))
+            if delays is not None:
+                loop = asyncio.get_running_loop()
+                for d in delays:
+                    if d > 0:
+                        loop.call_later(d, self._enqueue, frame)
+                    else:
+                        self._enqueue(frame)
+                return
+        self._enqueue(frame)
+
+    def _enqueue(self, frame: bytes) -> None:
+        if self.stopped:
+            return  # a shaped frame landing after shutdown
+        self.outbox.append(frame)
         peak = len(self.outbox)
         if peak > self.t.stats.send_queue_peak:
             self.t.stats.send_queue_peak = peak
@@ -451,20 +467,12 @@ class _PeerSender:
                 await self.wake.wait()
                 self.wake.clear()
                 while self.outbox:
-                    ready = self.outbox[0][0]
-                    if ready:
-                        now = time.monotonic()
-                        if ready > now:  # shaped link: frame not due yet
-                            await asyncio.sleep(ready - now)
-                    # write every queued (due) frame, then ONE drain for
-                    # the lot — per-frame drains cost a writer round trip
-                    # each and dominated the sequential-path profile
-                    now = time.monotonic() if self.delay > 0 else None
-                    batch = []
-                    for r, f in self.outbox:
-                        if now is not None and r > now:
-                            break
-                        batch.append(f)
+                    # write every queued frame, then ONE drain for the
+                    # lot — per-frame drains cost a writer round trip
+                    # each and dominated the sequential-path profile.
+                    # (Link shaping happens BEFORE the outbox — see
+                    # send(): a queued frame is already due.)
+                    batch = list(self.outbox)
                     async with wlock:
                         for f in batch:
                             writer.write(f)
@@ -572,6 +580,7 @@ class Transport:
         cost_model=None,
         registry=None,
         link_delays: Optional[Dict[NodeId, float]] = None,
+        shaper=None,
     ):
         self.our_id = our_id
         self.cluster_id = bytes(cluster_id)
@@ -586,19 +595,49 @@ class Transport:
         self.client_idle_timeout_s = client_idle_timeout_s
         self.max_frame = max_frame
         self.backoff = backoff or BackoffPolicy(seed=seed)
-        # per-peer OUTBOUND latency shaping (seconds): scenario/bench
-        # harness knob — frames to a shaped peer are held until
-        # enqueue + delay before hitting the socket (see _PeerSender)
-        self.link_delays: Dict[NodeId, float] = dict(link_delays or {})
         self.trace = trace
         self.cost_model = cost_model
         self.stats = TransportStats(registry)
+        # outbound link shaping — the real-socket side of the shared
+        # chaos.link hook: per-directed-edge latency/jitter/loss/dup/
+        # bandwidth/partition policies applied to this node's egress
+        # queue (see _PeerSender.send).  The legacy per-peer constant
+        # `link_delays` knob is now sugar for a constant-delay shaper.
+        # A shaper instance belongs to ONE transport (bind_registry
+        # re-homes its counters onto this node's registry).
+        self.link_delays: Dict[NodeId, float] = dict(link_delays or {})
+        if self.link_delays:
+            if shaper is not None:
+                # refusing beats silently dropping one of them: before
+                # the shared hook, link_delays ALWAYS applied
+                raise ValueError(
+                    "link_delays and a chaos shaper are mutually "
+                    "exclusive — express the constant delays as "
+                    "ShapedLink edges in the shaper's NetShape instead")
+            from hbbft_tpu.chaos.link import (
+                LinkShaper, NetShape, ShapedLink,
+            )
+
+            shaper = LinkShaper(NetShape(edges={
+                (our_id, peer): ShapedLink(delay_s=delay)
+                for peer, delay in self.link_delays.items()
+            }), seed=seed)
+        self.shaper = shaper
+        if shaper is not None:
+            shaper.bind_registry(self.stats.registry)
+        # the shaping clock: seconds since this transport was built —
+        # preset partition windows are relative to node start
+        self._chaos_t0 = time.monotonic()
         self._senders: Dict[NodeId, _PeerSender] = {}
         self._peer_ids_cache: Optional[List[NodeId]] = None
         self._server: Optional[asyncio.base_events.Server] = None
         self._inbound_tasks: set = set()
         self._stopping = False
         self.addr: Optional[Addr] = None
+
+    def chaos_now(self) -> float:
+        """The link-shaping clock (seconds since transport creation)."""
+        return time.monotonic() - self._chaos_t0
 
     # -- lifecycle -----------------------------------------------------------
 

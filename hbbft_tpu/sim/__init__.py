@@ -11,11 +11,16 @@ cross-checked against.
 
 from hbbft_tpu.sim.adversary import (
     Adversary,
+    CensorshipAdversary,
+    CrashAtEpochAdversary,
+    EclipseAdversary,
+    EquivocatingAdversary,
     MitmDelayAdversary,
     NodeOrderAdversary,
     NullAdversary,
     RandomAdversary,
     ReorderingAdversary,
+    TargetedDelayAdversary,
 )
 from hbbft_tpu.sim.trace import CostModel, CrankEvent, EventLog, NetEvent
 from hbbft_tpu.sim.virtual_net import CrankError, NetBuilder, VirtualNet

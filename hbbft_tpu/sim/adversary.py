@@ -20,14 +20,33 @@ class Adversary:
     ``pick_message`` returns the index into the queue to deliver next;
     ``tamper`` may rewrite a message addressed from/to a faulty node.
     Reference: ``trait Adversary { pre_crank, tamper }``.
+
+    Two network-level hooks beyond the reference trait:
+
+    - ``filter_message`` is consulted for EVERY enqueued message (not
+      just faulty senders') — returning None removes it from the network.
+      Censorship, eclipse and crash-stop adversaries live here: in the
+      asynchronous model the network itself is adversarial;
+    - ``pre_crank`` runs at the start of every crank (before delivery),
+      so time-triggered behavior (heals, releases) can fire even when
+      the live queue has momentarily drained.
     """
 
     def pick_message(self, net: "VirtualNet") -> int:
         return 0
 
+    def pre_crank(self, net: "VirtualNet") -> None:
+        """Called at the start of every crank, before delivery."""
+
     def tamper(self, net: "VirtualNet", msg: "NetworkMessage") -> Optional["NetworkMessage"]:
         """Return a replacement for a message sent BY a faulty node (or None
         to drop it).  Only called for messages from faulty senders."""
+        return msg
+
+    def filter_message(self, net: "VirtualNet",
+                       msg: "NetworkMessage") -> Optional["NetworkMessage"]:
+        """Network-level gate over every enqueued message; None removes
+        it (counted in ``net.adversary_filtered``)."""
         return msg
 
 
@@ -71,12 +90,21 @@ class MitmDelayAdversary(Adversary):
     budget allows so its estimate keeps lagging the coin.  With a threshold
     (unpredictable) coin the protocol must still terminate; a predictable
     coin could be stalled forever.
+
+    ``max_delay`` is the hold budget (consecutive cranks the target is
+    starved).  The no-arg default stays the historical fixed 200; passing
+    ``max_delay=None`` draws the budget from the seeded RNG instead
+    (uniform in [50, 500]) so campaign cells sweep it with their scenario
+    seed rather than all probing one magic number.
     """
 
-    def __init__(self, target, max_delay: int = 200, seed: int = 0):
+    def __init__(self, target, max_delay: Optional[int] = 200,
+                 seed: int = 0):
         self.target = target
-        self.max_delay = max_delay
         self.rng = random.Random(seed)
+        if max_delay is None:
+            max_delay = 50 + self.rng.randrange(0, 451)
+        self.max_delay = max_delay
         self._held = 0
 
     def pick_message(self, net: "VirtualNet") -> int:
@@ -232,4 +260,143 @@ class RandomAdversary(Adversary):
                 return dataclasses.replace(msg, msg=self._mutate(msg.msg))
             except Exception:
                 return msg
+        return msg
+
+
+class TargetedDelayAdversary(Adversary):
+    """Targeted message-delay against a SET of victims.
+
+    The zoo generalization of :class:`MitmDelayAdversary`: while a seeded
+    hold budget lasts, any message to or from a victim is starved (other
+    traffic is delivered first); when the budget runs out the backlog
+    floods through at once, and the cycle repeats.  Exposes ordering /
+    staleness assumptions without dropping anything.
+    """
+
+    def __init__(self, targets, max_hold: Optional[int] = None,
+                 seed: int = 0):
+        self.targets = set(targets)
+        self.rng = random.Random(seed)
+        if max_hold is None:
+            max_hold = 40 + self.rng.randrange(0, 261)
+        self.max_hold = max_hold
+        self._held = 0
+
+    def pick_message(self, net: "VirtualNet") -> int:
+        others = [
+            i for i, m in enumerate(net.queue)
+            if m.to not in self.targets and m.sender not in self.targets
+        ]
+        if others and self._held < self.max_hold:
+            self._held += 1
+            return self.rng.choice(others)
+        self._held = 0
+        return self.rng.randrange(len(net.queue))
+
+
+class CensorshipAdversary(Adversary):
+    """Selective censorship by message type and/or peer.
+
+    Messages matching EVERY given criterion (type name anywhere in the
+    wrapper chain; sender in ``senders``; destination in ``dests``; a
+    ``None`` criterion matches anything) are removed from the network —
+    up to a seeded budget, so liveness pressure is real but bounded and
+    the protocol's recovery after the censor exhausts itself is part of
+    the scenario.  Censored drops are counted both here (``censored``)
+    and on the net (``adversary_filtered``).
+    """
+
+    def __init__(self, msg_types=(), senders=None, dests=None,
+                 budget: Optional[int] = None, seed: int = 0):
+        self.msg_types = frozenset(msg_types)
+        self.senders = None if senders is None else set(senders)
+        self.dests = None if dests is None else set(dests)
+        self.rng = random.Random(seed)
+        if budget is None:
+            budget = 50 + self.rng.randrange(0, 451)
+        self.budget = budget
+        self.censored = 0
+
+    def filter_message(self, net: "VirtualNet", msg: "NetworkMessage"):
+        if self.censored >= self.budget:
+            return msg
+        if self.senders is not None and msg.sender not in self.senders:
+            return msg
+        if self.dests is not None and msg.to not in self.dests:
+            return msg
+        if self.msg_types:
+            from hbbft_tpu.sim.trace import msg_type_path
+
+            parts = set(msg_type_path(msg.payload).split("/"))
+            if not (parts & self.msg_types):
+                return msg
+        self.censored += 1
+        return None
+
+
+class EclipseAdversary(Adversary):
+    """Eclipse one CORRECT node: every message to or from the victim is
+    HELD (not dropped) until the heal, then the backlog is re-injected —
+    the victim is cut off while the rest of the cluster makes progress,
+    and must catch up from the flood afterwards.
+
+    The heal fires at ``heal_crank`` — or earlier, the moment the rest of
+    the network goes QUIESCENT (``net.quiescent``: nothing left in the
+    live queue or the shaper's held set — link-shaped traffic in flight
+    is not silence), so an eclipse can never deadlock a run whose only
+    remaining traffic is the held backlog.  Deterministic: no RNG at all.
+    """
+
+    def __init__(self, victim, heal_crank: int):
+        self.victim = victim
+        self.heal_crank = heal_crank
+        self.healed = False
+        self._held: List["NetworkMessage"] = []
+
+    def pending(self) -> int:
+        return len(self._held)
+
+    def filter_message(self, net: "VirtualNet", msg: "NetworkMessage"):
+        if not self.healed and (msg.to == self.victim
+                                or msg.sender == self.victim):
+            self._held.append(msg)
+            return None
+        return msg
+
+    def pre_crank(self, net: "VirtualNet") -> None:
+        if not self.healed and (net.cranks >= self.heal_crank
+                                or net.quiescent):
+            self.healed = True
+            net.queue.extend(self._held)
+            self._held.clear()
+
+
+class CrashAtEpochAdversary(Adversary):
+    """Crash-stop at epoch: once the victim node has produced
+    ``after_batches`` outputs (committed batches for a QHB stack), ALL
+    its subsequent traffic — both directions — is removed forever.  The
+    fail-stop shape consensus must tolerate for up to f nodes: the
+    remaining n−1 keep committing, the victim's ledger freezes at its
+    crash point (its journal simply ends — no fork, no fault).
+
+    Deterministic: the trigger is the victim's own output count.
+    Messages already in flight at the crash instant still deliver (the
+    usual fuzzy crash boundary).
+    """
+
+    def __init__(self, victim, after_batches: int = 1):
+        self.victim = victim
+        self.after_batches = after_batches
+        self.crashed = False
+        self.dropped = 0
+
+    def filter_message(self, net: "VirtualNet", msg: "NetworkMessage"):
+        if not self.crashed:
+            node = net.nodes.get(self.victim)
+            if node is not None and len(node.outputs) >= self.after_batches:
+                self.crashed = True
+        if self.crashed and (msg.sender == self.victim
+                             or msg.to == self.victim):
+            self.dropped += 1
+            return None
         return msg

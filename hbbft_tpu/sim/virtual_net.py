@@ -13,8 +13,9 @@ can be driven by custom algorithms supplied via ``NetBuilder.faulty``.
 
 from __future__ import annotations
 
+import heapq
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence
+from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence, Tuple
 
 from hbbft_tpu.fault_log import Fault, FaultLog
 from hbbft_tpu.sim.adversary import Adversary, NullAdversary
@@ -32,6 +33,10 @@ class NetworkMessage:
     sender: NodeId
     to: NodeId
     payload: Any
+    #: earliest virtual delivery time (set by link shaping; 0 = now).
+    #: The cost model floors the receiver's clock here, so shaped
+    #: latency shows up in per-cell virtual latency numbers.
+    at: float = 0.0
 
 
 @dataclass
@@ -53,6 +58,7 @@ class VirtualNet:
         trace: Optional["EventLog"] = None,
         cost_model: Optional["CostModel"] = None,
         observers: Optional[Dict[NodeId, Any]] = None,
+        shaper: Optional[Any] = None,
     ):
         self.nodes = nodes
         self.queue: List[NetworkMessage] = []
@@ -63,6 +69,15 @@ class VirtualNet:
         self.cranks = 0
         self.trace = trace
         self.cost_model = cost_model
+        # the shared link-shaping hook (chaos.link.LinkShaper): shaped
+        # messages wait in _held until the virtual clock reaches their
+        # delivery time; [] from the shaper means the frame was dropped
+        self.shaper = shaper
+        self._held: List[Tuple[float, int, NetworkMessage]] = []
+        self._held_seq = 0
+        # messages removed by the adversary's network-level gate
+        # (filter_message returning None) — censorship/eclipse/crash
+        self.adversary_filtered = 0
         # per-node traits.StepObserver hooks (e.g. obs.spans.SpanTracer):
         # each delivery/input to node i is reported to observers[i]
         self.observers: Dict[NodeId, Any] = observers or {}
@@ -92,9 +107,17 @@ class VirtualNet:
         self._process_step(node, step)
 
     def crank(self) -> Optional[NetworkMessage]:
-        """Deliver exactly one message; None if the queue is empty."""
+        """Deliver exactly one message; None if nothing is deliverable
+        (both the live queue and the shaper's held set are empty)."""
+        self.adversary.pre_crank(self)
+        self._release_due()
         if not self.queue:
-            return None
+            if not self._held:
+                return None
+            # every in-flight message is future-dated (a shaped lull):
+            # event-driven clock jump to the earliest delivery time
+            self.virtual_time = self._held[0][0]
+            self._release_due()
         self.cranks += 1
         if self.crank_limit is not None and self.cranks > self.crank_limit:
             raise CrankError(f"crank limit {self.crank_limit} exceeded")
@@ -118,7 +141,8 @@ class VirtualNet:
 
             nbytes = wire_size(msg.payload)
             if self.cost_model is not None:
-                t = self.node_times.get(msg.to, 0.0) + self.cost_model.charge(nbytes)
+                t = max(self.node_times.get(msg.to, 0.0), msg.at) \
+                    + self.cost_model.charge(nbytes)
                 self.node_times[msg.to] = t
                 self.virtual_time = max(self.virtual_time, t)
             if self.trace is not None:
@@ -150,9 +174,16 @@ class VirtualNet:
             if n > max_cranks:
                 raise CrankError(f"predicate not reached in {max_cranks} cranks")
 
+    @property
+    def quiescent(self) -> bool:
+        """Nothing left to deliver: the live queue AND the shaper's
+        held set are both empty (time-triggered adversaries check this,
+        not ``queue`` alone — shaped traffic in flight is not silence)."""
+        return not self.queue and not self._held
+
     def run_to_quiescence(self) -> None:
-        while self.queue:
-            self.crank()
+        while self.crank() is not None:
+            pass
 
     def close_observers(self) -> None:
         """Close any per-node observers that hold resources (the flight
@@ -163,6 +194,14 @@ class VirtualNet:
                 close()
 
     # -- internals ----------------------------------------------------------
+
+    def _release_due(self) -> None:
+        """Move shaped messages whose delivery time has arrived from the
+        held set into the live queue, in (ready, enqueue-seq) order."""
+        held = self._held
+        while held and held[0][0] <= self.virtual_time:
+            _ready, _seq, msg = heapq.heappop(held)
+            self.queue.append(msg)
 
     def _process_step(self, node: Node, step: Step) -> None:
         node.outputs.extend(step.output)
@@ -176,7 +215,39 @@ class VirtualNet:
                     if tampered is None:
                         continue
                     msg = tampered
-                self.queue.append(msg)
+                # network-level adversary gate: censorship, eclipse and
+                # crash-stop apply to EVERY message, not just faulty
+                # senders' (the async model's network IS the adversary)
+                filtered = self.adversary.filter_message(self, msg)
+                if filtered is None:
+                    self.adversary_filtered += 1
+                    continue
+                self._enqueue(filtered)
+
+    def _enqueue(self, msg: NetworkMessage) -> None:
+        """The simulator side of the shared shaping hook: consult the
+        LinkShaper (if any) per directed edge; future-dated copies wait
+        in the held set until the virtual clock reaches them."""
+        if self.shaper is not None:
+            from hbbft_tpu.sim.trace import wire_size
+
+            delays = self.shaper.shape_frame(
+                msg.sender, msg.to, self.virtual_time,
+                size_fn=lambda: wire_size(msg.payload))
+            if delays is not None:
+                for d in delays:
+                    if d <= 0:
+                        self.queue.append(msg)
+                    else:
+                        ready = self.virtual_time + d
+                        self._held_seq += 1
+                        heapq.heappush(
+                            self._held,
+                            (ready, self._held_seq,
+                             NetworkMessage(msg.sender, msg.to,
+                                            msg.payload, at=ready)))
+                return
+        self.queue.append(msg)
 
 
 class NetBuilder:
@@ -195,6 +266,7 @@ class NetBuilder:
         self._trace = None
         self._cost_model = None
         self._observer_factory: Optional[Callable[[NodeId], Any]] = None
+        self._shaper = None
 
     def faulty(self, ids: Sequence[NodeId]) -> "NetBuilder":
         self._faulty = set(ids)
@@ -225,6 +297,19 @@ class NetBuilder:
     def cost_model(self, model) -> "NetBuilder":
         """Attach an :class:`hbbft_tpu.sim.trace.CostModel` (virtual clock)."""
         self._cost_model = model
+        return self
+
+    def shape(self, shape, seed: int = 0) -> "NetBuilder":
+        """Attach link shaping — the simulator side of the shared hook
+        (:mod:`hbbft_tpu.chaos.link`).  ``shape`` is a ``NetShape`` (or a
+        prebuilt ``LinkShaper``); times are in VIRTUAL seconds, so pair
+        this with :meth:`cost_model` so the virtual clock advances (the
+        net still progresses without one — an all-held queue jumps the
+        clock to the next delivery — but latency numbers mean nothing)."""
+        from hbbft_tpu.chaos.link import LinkShaper
+
+        self._shaper = (shape if isinstance(shape, LinkShaper)
+                        else LinkShaper(shape, seed=seed))
         return self
 
     def observe(self, factory: Callable[[NodeId], Any]) -> "NetBuilder":
@@ -288,4 +373,5 @@ class NetBuilder:
                 {nid: self._observer_factory(nid) for nid in self.ids}
                 if self._observer_factory is not None else None
             ),
+            shaper=self._shaper,
         )

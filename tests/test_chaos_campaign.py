@@ -1,0 +1,224 @@
+"""Chaos campaign runner — the acceptance scenarios.
+
+Tier 1 keeps the fast pieces: a 6-cell all-clean smoke over every
+link-shaping preset, the equivocator-under-loss auto-triage (correct
+faulty node + first divergent epoch), byte-identical replay from a
+reported spec, one socket churn cell, and the CLI.  The full ≥100-cell
+sweep is marked ``slow``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from hbbft_tpu.chaos.campaign import (
+    ADVERSARIES,
+    CellSpec,
+    SIM_SCALES,
+    full_grid,
+    main as campaign_main,
+    replay_matches,
+    run_campaign,
+    run_cell,
+    run_churn_cell,
+    smoke_grid,
+)
+from hbbft_tpu.chaos.link import PRESETS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_smoke_grid_all_clean_and_committing(tmp_path):
+    """The tier-1 campaign smoke: six seeded cells spanning every preset
+    must commit batches on every correct node and audit clean."""
+    specs = smoke_grid()
+    assert {s.shape for s in specs} == set(PRESETS)
+    report = run_campaign(specs, str(tmp_path))
+    assert report["cells"] == len(specs)
+    assert report["verdicts"] == {"clean": len(specs)}, report["triage"]
+    assert report["errors"] == 0
+    assert report["stalled_cells"] == 0
+    assert report["triage"] == []
+    # shaping really happened: delays, at least one drop (lossy), a dup
+    # (dup-reorder) and a partition hold crossed the campaign
+    frames = report["frames"]
+    assert frames["delayed"] > 0 and frames["duplicated"] > 0
+    assert frames["partition_holds"] > 0
+    # report schema: the trajectory/--compare surface
+    assert report["metric"] == "chaos_campaign"
+    assert report["unit"] == "clean_fraction" and report["value"] == 1.0
+    assert report["epoch_virtual_s_p50"] > 0
+    for d in report["cells_detail"]:
+        assert d["batches_min"] >= 1
+        assert d["spec"] == CellSpec.from_dict(d["spec"]).as_dict()
+
+
+def test_equivocator_under_loss_is_triaged_to_node_and_epoch(tmp_path):
+    """Acceptance: the intentionally-faulty cell (equivocator under
+    loss) is auto-triaged to the correct faulty node and the first
+    divergent epoch, with the replay spec attached."""
+    spec = CellSpec(shape="lossy-1pct", adversary="equivocate", seed=0,
+                    crank_limit=60_000)
+    assert spec.faulty == (3,)
+    report = run_campaign([spec], str(tmp_path), verify_nonclean=False)
+    assert report["verdicts"] == {"fault": 1}
+    (entry,) = report["triage"]
+    assert entry["faulty_nodes"] == ["3"]
+    assert entry["first_divergent_epoch"] is not None
+    era, epoch = entry["first_divergent_epoch"]
+    assert era == 0 and epoch >= 0
+    assert any(k.startswith("Multiple") for k in entry["kinds"])
+    # the replay block IS a loadable CellSpec
+    assert CellSpec.from_dict(entry["replay"]["spec"]) == spec
+
+
+def test_cell_replays_byte_identically(tmp_path):
+    """Acceptance: a cell re-run from its reported seed + spec produces
+    a byte-identical merged audit timeline; a different seed does not."""
+    spec = CellSpec(shape="lossy-1pct", adversary="reorder", seed=1)
+    d1, _res = run_cell(spec, str(tmp_path / "a"))
+    assert replay_matches(spec, d1["timeline_digest"],
+                          str(tmp_path / "b"))
+    d3, _res = run_cell(CellSpec(shape="lossy-1pct", adversary="reorder",
+                                 seed=2), str(tmp_path / "c"))
+    assert d3["timeline_digest"] != d1["timeline_digest"]
+
+
+def test_mitm_delay_budget_sweeps_with_seed():
+    """Satellite: MitmDelayAdversary's budget comes from the scenario
+    seed when unset, while the no-arg default stays 200."""
+    from hbbft_tpu.sim.adversary import MitmDelayAdversary
+
+    assert MitmDelayAdversary(target=0).max_delay == 200
+    budgets = {MitmDelayAdversary(target=0, max_delay=None,
+                                  seed=s).max_delay for s in range(8)}
+    assert len(budgets) > 1
+    assert all(50 <= b <= 500 for b in budgets)
+    # deterministic per seed
+    assert (MitmDelayAdversary(target=0, max_delay=None, seed=3).max_delay
+            == MitmDelayAdversary(target=0, max_delay=None,
+                                  seed=3).max_delay)
+
+
+def test_churn_cell_restarts_and_audits_clean(tmp_path):
+    """Kill/restart storm over a real in-process socket cluster: the
+    restarted nodes catch up, the incident audits clean, and the
+    restarts are visible as journal incarnations."""
+    detail, res = run_churn_cell(
+        CellSpec(kind="churn", seed=0, restarts=1), str(tmp_path))
+    assert detail["verdict"] == "clean", res.as_dict()
+    assert detail["batches_min"] >= 2
+    assert sum(detail["restarts"].values()) >= 1
+    assert detail["common_prefix_len"] >= 1
+
+
+def test_campaign_cli_smoke(tmp_path):
+    out = tmp_path / "report.json"
+    rc = campaign_main(["--grid", "smoke", "--max-cells", "2",
+                        "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["cells"] == 2 and doc["verdicts"] == {"clean": 2}
+    # ephemeral journals are not advertised in the report
+    assert all("journal" not in d for d in doc["cells_detail"])
+
+
+def test_campaign_module_entry_point(tmp_path):
+    """The literal ``python -m hbbft_tpu.chaos.campaign`` invocation."""
+    out = tmp_path / "report.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "hbbft_tpu.chaos.campaign",
+         "--grid", "smoke", "--max-cells", "1", "--out", str(out)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(out.read_text())
+    assert doc["metric"] == "chaos_campaign" and doc["cells"] == 1
+
+
+def test_replay_cli_verifies_byte_identity(tmp_path, capsys):
+    spec = CellSpec(shape="dup-reorder", adversary="equivocate", seed=1,
+                    crank_limit=60_000)
+    rc = campaign_main(["--replay", json.dumps(spec.as_dict()),
+                        "--journal-root", str(tmp_path / "j")])
+    assert rc == 0  # non-clean verdict, but byte-identical replay
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # --journal-root given → the advertised journal survives the run
+    assert os.path.isdir(doc["journal"])
+    # without --journal-root the temp journals are deleted on exit, so
+    # the path must not be advertised at all (no dangling forensics)
+    rc = campaign_main(["--replay", json.dumps(spec.as_dict())])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "journal" not in doc
+
+
+def test_eclipse_does_not_heal_while_shaped_traffic_in_flight():
+    """A shaped lull is not quiescence: with every in-flight message in
+    the shaper's held set and an empty live queue, the eclipse must NOT
+    take its early heal — only true quiescence (or heal_crank) ends it."""
+    import heapq
+
+    from hbbft_tpu.sim.adversary import EclipseAdversary
+    from hbbft_tpu.sim.virtual_net import NetworkMessage, VirtualNet
+
+    adv = EclipseAdversary(victim=0, heal_crank=100)
+    net = VirtualNet({}, adversary=adv)
+    assert adv.filter_message(net, NetworkMessage(0, 1, b"x")) is None
+    assert adv.pending() == 1
+    net._held_seq += 1
+    heapq.heappush(net._held, (5.0, net._held_seq,
+                               NetworkMessage(1, 2, b"y", at=5.0)))
+    adv.pre_crank(net)  # queue empty BUT shaped traffic in flight
+    assert not adv.healed
+    net._held.clear()
+    adv.pre_crank(net)  # true quiescence → early heal, backlog released
+    assert adv.healed and adv.pending() == 0
+    assert len(net.queue) == 1
+
+
+def test_compare_gate_reads_clean_fraction():
+    """The report line gates through bench.py --compare: a clean-fraction
+    drop beyond threshold is a regression, a rise is not."""
+    sys.path.insert(0, REPO)
+    from bench import compare_bench
+
+    old = {"metric": "chaos_campaign", "value": 0.95,
+           "unit": "clean_fraction"}
+    worse = compare_bench(old, dict(old, value=0.70))
+    assert not worse["ok"] and "value" in worse["regressions"]
+    better = compare_bench(old, dict(old, value=1.0))
+    assert better["ok"]
+
+
+@pytest.mark.slow
+def test_full_sweep_meets_acceptance(tmp_path):
+    """One invocation: ≥ 100 seeded cells over ≥ 4 shaping policies and
+    ≥ 4 adversaries, every cell audited, every equivocator triaged to
+    the correct faulty node, and every non-clean correct-node verdict
+    (if any) reproduced byte-identically."""
+    specs = full_grid(seeds=[0, 1], churn_cells=2)
+    assert len(specs) >= 100
+    report = run_campaign(specs, str(tmp_path))
+    assert report["cells"] == len(specs)
+    assert report["errors"] == 0
+    assert len([p for p in report["policies"] if p != "none"]) >= 4
+    assert len(report["adversaries"]) >= 4
+    assert sum(report["verdicts"].values()) == report["cells"]
+    equivocate_cells = [s for s in specs if s.adversary == "equivocate"]
+    fault_triage = [t for t in report["triage"]
+                    if t["verdict"] == "fault"]
+    assert len(fault_triage) == len(equivocate_cells)
+    for entry in fault_triage:
+        spec = CellSpec.from_dict(entry["replay"]["spec"])
+        assert entry["faulty_nodes"] == [str(spec.n - 1)]
+        assert entry["first_divergent_epoch"] is not None
+    # any non-clean verdict from a correct-node cell must have been
+    # reproduced byte-identically from its reported seed
+    for entry in report["triage"]:
+        if "reproduced" in entry:
+            assert entry["reproduced"] is True, entry

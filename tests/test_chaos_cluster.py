@@ -1,0 +1,164 @@
+"""Chaos on the REAL transport: shaped LocalClusters.
+
+The satellite contract: a client submitting across a timed
+partition-and-heal either commits after the heal or fails loudly — a
+``wait_committed`` future must never wedge silently.  Plus the preset
+plumbing (``ClusterConfig.chaos`` → per-node shaper → ``/metrics``).
+"""
+
+import asyncio
+
+import pytest
+
+from hbbft_tpu.chaos.link import LinkShaper, NetShape, ShapedLink
+from hbbft_tpu.net.cluster import (
+    ClusterConfig,
+    LocalCluster,
+    build_runtime,
+    generate_infos,
+)
+
+SCENARIO_TIMEOUT_S = 90
+
+
+def _partition_shaper(nid: int, n: int, victim: int,
+                      window) -> LinkShaper:
+    """Hold-mode partition isolating ``victim`` on every crossing edge
+    of node ``nid``'s egress during ``window`` (transport clock)."""
+    link = ShapedLink(partitions=(window,))
+    edges = {}
+    if nid == victim:
+        edges = {(victim, other): link for other in range(n)
+                 if other != victim}
+    else:
+        edges = {(nid, victim): link}
+    return LinkShaper(NetShape(edges=edges), seed=nid)
+
+
+def test_client_across_partition_and_heal_commits_or_fails_loudly():
+    """Transactions submitted to a partitioned node: wait_committed
+    FAILS LOUDLY (TimeoutError) while the partition lasts, and the same
+    transaction COMMITS once the link heals — no silent wedge.  The
+    majority side keeps committing throughout."""
+
+    async def scenario():
+        n, victim = 4, 0
+        window = (0.0, 2.0)  # victim isolated from transport start
+        cfg = ClusterConfig(n=n, seed=51, batch_size=4,
+                            heartbeat_s=0.3, dead_after_s=5.0)
+        infos = generate_infos(cfg)
+        runtimes = [
+            build_runtime(cfg, infos, nid,
+                          shaper=_partition_shaper(nid, n, victim,
+                                                   window))
+            for nid in range(n)
+        ]
+        addrs = {}
+        for nid, rt in enumerate(runtimes):
+            addrs[nid] = await rt.start(cfg.host, 0)
+        for rt in runtimes:
+            rt.connect(addrs)
+        try:
+            from hbbft_tpu.net.client import ClusterClient
+
+            # client on the partitioned node: the client socket is NOT
+            # shaped (shaping is consensus egress), so admission works —
+            # but the node cannot drive consensus until the heal
+            c_victim = ClusterClient(addrs[victim], cfg.cluster_id,
+                                     client_id="c-victim")
+            await c_victim.connect()
+            tx_v = b"partitioned-tx"
+            assert await c_victim.submit(tx_v) == 0
+            # ... fails loudly while partitioned (future resolved by
+            # wait_for's TimeoutError, not a silent wedge)
+            with pytest.raises(asyncio.TimeoutError):
+                await c_victim.wait_committed(tx_v, timeout_s=0.8)
+
+            # the majority side commits right through the partition
+            c_major = ClusterClient(addrs[1], cfg.cluster_id,
+                                    client_id="c-major")
+            await c_major.connect()
+            txs = [b"majority-%02d" % i for i in range(8)]
+            for tx in txs:
+                assert await c_major.submit(tx) == 0
+            for tx in txs:
+                await c_major.wait_committed(tx, timeout_s=30)
+
+            # after the heal, the held frames flood through and the
+            # victim's transaction commits — the SAME future path that
+            # timed out above now resolves
+            lat = await c_victim.wait_committed(tx_v, timeout_s=45)
+            assert lat >= 0.0
+            # every ledger agrees wherever the chains overlap
+            tails = [(rt.digest_chain_offset, rt.digest_chain)
+                     for rt in runtimes]
+            lo = max(off for off, _c in tails)
+            hi = min(off + len(c) for off, c in tails)
+            assert hi - lo >= 1
+            for i in range(lo, hi):
+                assert len({c[i - off] for off, c in tails}) == 1
+            # the shaping showed up in the victim's metrics
+            stats = runtimes[victim].transport.shaper.stats()
+            assert stats["partition_holds"] > 0
+            await c_victim.close()
+            await c_major.close()
+        finally:
+            for rt in runtimes:
+                await rt.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), SCENARIO_TIMEOUT_S))
+
+
+def test_cluster_config_chaos_preset_plumbs_to_runtime():
+    """ClusterConfig.chaos builds one shaper per node over the preset,
+    and LocalCluster serves its counters on /metrics."""
+
+    async def scenario():
+        cfg = ClusterConfig(n=4, seed=9, batch_size=4, chaos="wan-100ms")
+        shaper = cfg.chaos_shaper_for(0)
+        assert shaper.policy_for(0, 1).delay_s == pytest.approx(0.05)
+        cluster = LocalCluster(cfg)
+        await cluster.start()
+        try:
+            assert all(rt.transport.shaper is not None
+                       for rt in cluster.runtimes)
+            client = await cluster.client(0)
+            txs = [b"wan-%02d" % i for i in range(4)]
+            for tx in txs:
+                assert await client.submit(tx) == 0
+            for tx in txs:
+                await client.wait_committed(tx, timeout_s=45)
+            # shaped frames are visible on the node's live metrics
+            from hbbft_tpu.obs.http import http_get
+
+            host, port = cluster.metrics_addrs[0]
+            text = await asyncio.to_thread(http_get, host, port,
+                                           "/metrics")
+            for line in text.splitlines():
+                if line.startswith("hbbft_chaos_frames_shaped_total"):
+                    assert float(line.split()[-1]) > 0
+                    break
+            else:
+                raise AssertionError("hbbft_chaos_frames_shaped_total "
+                                     "not exposed")
+        finally:
+            await cluster.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), SCENARIO_TIMEOUT_S))
+
+
+def test_chaos_seed_controls_fault_schedule():
+    """Same preset + same seed → the same per-edge fault decisions;
+    a different chaos seed diverges (the interactive replay contract of
+    examples/cluster.py --chaos)."""
+    cfg_a = ClusterConfig(n=4, seed=3, chaos="lossy-1pct")
+    cfg_b = ClusterConfig(n=4, seed=3, chaos="lossy-1pct")
+    cfg_c = ClusterConfig(n=4, seed=3, chaos="lossy-1pct", chaos_seed=99)
+
+    def draws(cfg):
+        shaper = cfg.chaos_shaper_for(2)
+        return [shaper.shape_frame(2, 0, 0.0, nbytes=64)
+                for _ in range(300)]
+
+    assert draws(cfg_a) == draws(cfg_b)
+    assert draws(cfg_a) != draws(cfg_c)

@@ -1,0 +1,160 @@
+"""chaos.link: the shared link-shaping layer, in isolation.
+
+Determinism is the load-bearing property: the campaign's replay
+guarantee rests on a (seed, schedule) pair producing identical shaping
+decisions — so these tests pin the per-edge RNG streams, every fault
+class (loss/dup/reorder/bandwidth/partitions), the accounting counters,
+and the transport's legacy ``link_delays`` knob now riding the same
+hook.
+"""
+
+import pytest
+
+from hbbft_tpu.chaos.link import (
+    LinkShaper,
+    NetShape,
+    PRESETS,
+    ShapedLink,
+    preset_shape,
+)
+
+
+def _decisions(shaper, n=200, edge=("a", "b"), now=0.0, nbytes=100):
+    return [shaper.shape_frame(edge[0], edge[1], now, nbytes=nbytes)
+            for _ in range(n)]
+
+
+def test_same_seed_same_decisions_different_seed_differs():
+    shape = NetShape(default=ShapedLink(delay_s=0.01, jitter_s=0.02,
+                                        loss=0.1, dup=0.1))
+    a = _decisions(LinkShaper(shape, seed=7))
+    b = _decisions(LinkShaper(shape, seed=7))
+    c = _decisions(LinkShaper(shape, seed=8))
+    assert a == b
+    assert a != c
+
+
+def test_edges_draw_independent_streams():
+    """One edge's draw count must not perturb another's (each edge owns
+    a derived RNG, like the transport's backoff streams)."""
+    shape = NetShape(default=ShapedLink(jitter_s=0.5))
+    s1 = LinkShaper(shape, seed=3)
+    s2 = LinkShaper(shape, seed=3)
+    # interleave a foreign edge's draws on s1 only
+    seq1 = []
+    for i in range(50):
+        seq1.append(s1.shape_frame(0, 1, 0.0))
+        s1.shape_frame(2, 3, 0.0)
+    seq2 = [s2.shape_frame(0, 1, 0.0) for _ in range(50)]
+    assert seq1 == seq2
+
+
+def test_unshaped_edge_returns_none_and_counts_nothing():
+    shaper = LinkShaper(NetShape(edges={(0, 1): ShapedLink()}))
+    assert shaper.shape_frame(1, 0, 0.0) is None
+    assert shaper.stats()["shaped"] == 0
+    assert shaper.shape_frame(0, 1, 0.0) == [0.0]
+    assert shaper.stats()["shaped"] == 1
+
+
+def test_loss_drops_and_counts():
+    shaper = LinkShaper(NetShape(default=ShapedLink(loss=1.0)), seed=1)
+    assert shaper.shape_frame(0, 1, 0.0) == []
+    assert shaper.stats()["dropped"] == 1
+
+
+def test_duplication_emits_extra_copies():
+    shaper = LinkShaper(NetShape(default=ShapedLink(dup=1.0,
+                                                    delay_s=0.01)),
+                        seed=1)
+    delays = shaper.shape_frame(0, 1, 0.0)
+    assert len(delays) == 2
+    assert shaper.stats()["duplicated"] == 1
+    # copies are not byte-simultaneous
+    assert delays[0] != delays[1]
+
+
+def test_bandwidth_cap_serializes_per_edge():
+    # 8000 bps → a 100-byte frame takes 0.1 s on the wire; back-to-back
+    # frames queue behind each other, and the queue drains with time
+    link = ShapedLink(bandwidth_bps=8000.0)
+    assert link.needs_size
+    shaper = LinkShaper(NetShape(default=link))
+    d1 = shaper.shape_frame(0, 1, 0.0, nbytes=100)
+    d2 = shaper.shape_frame(0, 1, 0.0, nbytes=100)
+    assert d1 == [pytest.approx(0.1)]
+    assert d2 == [pytest.approx(0.2)]
+    # another edge has its own queue
+    assert shaper.shape_frame(0, 2, 0.0, nbytes=100) == [
+        pytest.approx(0.1)]
+    # after the queue clears, delay resets
+    assert shaper.shape_frame(0, 1, 10.0, nbytes=100) == [
+        pytest.approx(0.1)]
+
+
+def test_partition_hold_delivers_at_heal_and_counts():
+    link = ShapedLink(partitions=((1.0, 3.0),))
+    shaper = LinkShaper(NetShape(default=link))
+    assert shaper.shape_frame(0, 1, 0.5) == [0.0]      # before window
+    held = shaper.shape_frame(0, 1, 1.5)               # inside window
+    assert held == [pytest.approx(1.5)]                # due at the heal
+    assert shaper.shape_frame(0, 1, 3.0) == [0.0]      # healed
+    assert shaper.stats()["partition_holds"] == 1
+    assert shaper.stats()["dropped"] == 0
+
+
+def test_partition_drop_mode_loses_frames():
+    link = ShapedLink(partitions=((1.0, 3.0),), partition_mode="drop")
+    shaper = LinkShaper(NetShape(default=link))
+    assert shaper.shape_frame(0, 1, 2.0) == []
+    assert shaper.stats()["dropped"] == 1
+
+
+def test_scaled_rescales_every_time_constant():
+    link = ShapedLink(delay_s=1.0, jitter_s=2.0, reorder_spread_s=4.0,
+                      bandwidth_bps=8000.0, partitions=((10.0, 20.0),))
+    s = link.scaled(0.001)
+    assert s.delay_s == pytest.approx(0.001)
+    assert s.jitter_s == pytest.approx(0.002)
+    assert s.reorder_spread_s == pytest.approx(0.004)
+    assert s.partitions == ((pytest.approx(0.01), pytest.approx(0.02)),)
+    # a frame's transmission time scales with the clock: 8·n/bps' = k·8·n/bps
+    assert 8.0 * 100 / s.bandwidth_bps == pytest.approx(
+        0.001 * 8.0 * 100 / link.bandwidth_bps)
+    # probabilities are NOT time constants
+    lossy = ShapedLink(loss=0.25, dup=0.5).scaled(0.001)
+    assert lossy.loss == 0.25 and lossy.dup == 0.5
+
+
+def test_presets_cover_every_name_and_reject_unknown():
+    for name in PRESETS:
+        shape = preset_shape(name, 4)
+        if name != "none":
+            assert (shape.default is not None or shape.edges), name
+    with pytest.raises(ValueError, match="unknown chaos preset"):
+        preset_shape("nope", 4)
+    # the partition preset isolates node n-1 in BOTH directions
+    shape = preset_shape("partition-10s", 4)
+    assert shape.policy_for(3, 0).partitions
+    assert shape.policy_for(0, 3).partitions
+    assert not shape.policy_for(0, 1).partitions
+
+
+def test_transport_link_delays_ride_the_shared_hook():
+    """The legacy per-peer constant-delay knob is now sugar for a
+    constant-delay ShapedLink on this node's egress edges."""
+    from hbbft_tpu.net.transport import Transport
+
+    t = Transport(0, b"cid", link_delays={1: 0.02, 2: 0.05})
+    assert t.shaper is not None
+    assert t.shaper.policy_for(0, 1).delay_s == pytest.approx(0.02)
+    assert t.shaper.policy_for(0, 2).delay_s == pytest.approx(0.05)
+    assert t.shaper.policy_for(0, 3) is None
+    # shaping counters live on the node's registry (hbbft_chaos_*)
+    text = t.stats.registry.render_prometheus()
+    assert "hbbft_chaos_frames_dropped_total" in text
+    # both knobs at once is a config conflict, refused loudly (before
+    # the shared hook, link_delays always applied — never drop one)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        Transport(0, b"cid", link_delays={1: 0.02},
+                  shaper=LinkShaper(NetShape()))

@@ -189,6 +189,23 @@ def test_scope_and_basic_finding(tmp_path):
     assert result.findings[0].line == 4
 
 
+def test_chaos_is_in_determinism_and_drop_scopes(tmp_path):
+    """chaos/ shaping decisions must come from the seeded RNG (campaign
+    replay depends on it), and its drop paths must be accounted."""
+    assert "hbbft_tpu/chaos/" in DeterminismChecker.scope
+    assert "hbbft_tpu/chaos/" in FaultAccountingChecker.DROP_SCOPE
+    _write(tmp_path, "hbbft_tpu/chaos/z.py", _VIOLATION)
+    result = _lint_tmp(tmp_path)
+    assert [f.rule for f in result.findings] == ["det-wall-clock"]
+    _write(tmp_path, "hbbft_tpu/chaos/drop.py",
+           "def f(x):\n    try:\n        return x()\n"
+           "    except ValueError:\n        return None\n")
+    result = run_lint(root=str(tmp_path), paths=["hbbft_tpu/chaos"],
+                      checkers=[FaultAccountingChecker()],
+                      baseline_path=None)
+    assert [f.rule for f in result.findings] == ["fault-swallowed-drop"]
+
+
 def test_suppression_same_line(tmp_path):
     _write(tmp_path, "hbbft_tpu/protocols/x.py",
            "import time\n\ndef f():\n"
