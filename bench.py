@@ -397,14 +397,17 @@ def bench_dkg256(t: int = 85):
         row_best = BT.commitment_row(com, 3)
         times.append(time.perf_counter() - t0)
     t_best = float(np.median(times))
-    # label what commitment_row ACTUALLY ran: the oracle below the
-    # min-batch threshold, the device ladder above it (row-sharded iff a
-    # mesh is routed through crypto.batch.use_mesh — the mesh never
-    # changes the dispatch decision, only where ladder rows execute)
+    # label what commitment_row ACTUALLY ran: the host path below the
+    # min-batch threshold (Horner-form evaluation whose scalar-muls are
+    # by the small node index itself — see tc.BivarCommitment.row and
+    # bls12_381.SMALL_SCALAR_BITS), the device ladder above it
+    # (row-sharded iff a mesh is routed through crypto.batch.use_mesh —
+    # the mesh never changes the dispatch decision, only where ladder
+    # rows execute)
     if BT._device_worthwhile(muls):
         best_path = "device+mesh" if BT._CACHE.mesh is not None else "device"
     else:
-        best_path = "oracle"
+        best_path = "host-horner"
 
     # secondary diagnostic: the device ladder, forced
     saved_min = BT.DEVICE_DKG_MIN_BATCH

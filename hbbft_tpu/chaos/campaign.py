@@ -60,6 +60,7 @@ from hbbft_tpu.sim.adversary import (
     MitmDelayAdversary,
     NullAdversary,
     ReorderingAdversary,
+    VoteStormAdversary,
 )
 from hbbft_tpu.sim.trace import CostModel
 
@@ -96,8 +97,9 @@ class CellSpec:
     seed: int = 0                # drives protocol RNGs, shaping, adversary
     time_scale: float = 1e-3     # preset times × this (virtual seconds)
     crank_limit: int = 40_000
-    kind: str = "sim"            # "sim" | "churn"
+    kind: str = "sim"            # "sim" | "churn" | "socket"
     restarts: int = 2            # churn cells: kill/restart count
+    pipeline_depth: int = 1      # socket cells: epochs kept in flight
 
     @property
     def name(self) -> str:
@@ -122,7 +124,7 @@ class CellSpec:
 #: the adversary zoo, by campaign name
 ADVERSARIES: Tuple[str, ...] = (
     "null", "reorder", "mitm-delay", "censor-ready", "eclipse", "crash",
-    "equivocate",
+    "equivocate", "vote-storm",
 )
 
 #: per-preset sim time scale: presets are written in real seconds, cells
@@ -162,6 +164,11 @@ def make_adversary(spec: CellSpec):
                                      after_batches=1 + seed % 2)
     if name == "equivocate":
         return EquivocatingAdversary()
+    if name == "vote-storm":
+        # membership-vote storms: coordinated remove/re-add waves drive
+        # REAL DKG rotations mid-run (mid-partition under the
+        # partition-10s preset); split waves stall without a winner
+        return VoteStormAdversary(seed=seed)
     raise ValueError(f"unknown adversary {name!r} "
                      f"(known: {', '.join(ADVERSARIES)})")
 
@@ -223,12 +230,16 @@ def run_cell(spec: CellSpec, cell_dir: str
         for nid in correct
     }
     min_b = min(batches.values())
+    eras = max(
+        (o.era for nid in correct for o in net.nodes[nid].outputs
+         if isinstance(o, QhbBatch)), default=0)
     detail = {
         "cell": spec.name,
         "spec": spec.as_dict(),
         "verdict": res.verdict,
         "batches_min": min_b,
         "batches_max": max(batches.values()),
+        "eras_rotated": eras,
         "stalled": min_b == 0,
         "cranks": net.cranks,
         "virtual_time_s": round(net.virtual_time, 6),
@@ -334,6 +345,89 @@ def run_churn_cell(spec: CellSpec, cell_dir: str
 
 
 # ===========================================================================
+# Socket cells (WAN-shaped PIPELINED cluster liveness)
+# ===========================================================================
+
+
+async def _socket_scenario(spec: CellSpec, cell_dir: str
+                           ) -> Dict[str, Any]:
+    """A real socket cluster at ``pipeline_depth > 1`` under a chaos
+    preset at its REAL timings (wan latency in actual milliseconds):
+    traffic must keep committing and the whole incident must audit
+    clean — the pipelined liveness point of the chaos trajectory."""
+    import asyncio
+    import time
+
+    from hbbft_tpu.net.cluster import (
+        ClusterConfig,
+        LocalCluster,
+        find_free_base_port,
+    )
+
+    cfg = ClusterConfig(
+        n=spec.n, seed=spec.seed, batch_size=spec.batch_size,
+        base_port=find_free_base_port(spec.n),
+        heartbeat_s=0.3, dead_after_s=3.0,
+        flight_dir=cell_dir,
+        pipeline_depth=spec.pipeline_depth,
+        chaos=spec.shape if spec.shape != "none" else "",
+        chaos_seed=spec.seed,
+    )
+    cluster = LocalCluster(cfg)
+    await cluster.start()
+    try:
+        client = await cluster.client(0)
+        txs = [b"sock-%04d" % i for i in range(spec.txs)]
+        # hblint: disable=det-wall-clock (socket cells run a REAL-time
+        # cluster under real-second chaos presets: wall time here is the
+        # measured liveness metric, not replica logic — sim cells stay
+        # on the virtual clock)
+        t0 = time.monotonic()
+        for tx in txs:
+            status = await client.submit(tx)
+            if status != 0:
+                raise AssertionError(
+                    f"socket cell tx rejected with status {status}")
+        for tx in txs:
+            await client.wait_committed(tx, timeout_s=120)
+        # hblint: disable=det-wall-clock (same measured-liveness read)
+        wall = time.monotonic() - t0
+        await cluster.wait_epochs(min_batches=1, timeout_s=60)
+        prefix = cluster.common_digest_prefix()
+        batches = [len(rt.batches) for rt in cluster.runtimes]
+        return {
+            "batches_min": min(batches),
+            "batches_max": max(batches),
+            "commit_wall_s": round(wall, 3),
+            "common_prefix_len": len(prefix),
+        }
+    finally:
+        await cluster.stop()
+
+
+def run_socket_cell(spec: CellSpec, cell_dir: str
+                    ) -> Tuple[Dict[str, Any], AuditResult]:
+    import asyncio
+
+    live = asyncio.run(asyncio.wait_for(
+        _socket_scenario(spec, cell_dir), 300))
+    res, _journals = run_audit([cell_dir])
+    detail = {
+        "cell": spec.name,
+        "spec": spec.as_dict(),
+        "verdict": res.verdict,
+        "batches_min": live["batches_min"],
+        "batches_max": live["batches_max"],
+        "stalled": live["batches_min"] == 0,
+        "commit_wall_s": live["commit_wall_s"],
+        "common_prefix_len": live["common_prefix_len"],
+        "pipeline_depth": spec.pipeline_depth,
+        "journal": cell_dir,
+    }
+    return detail, res
+
+
+# ===========================================================================
 # Grids
 # ===========================================================================
 
@@ -347,7 +441,11 @@ def full_grid(seeds: Sequence[int] = (0, 1),
     for seed in seeds:
         for shape in PRESETS:
             for adv in ADVERSARIES:
-                limit = 60_000 if adv == "equivocate" else 40_000
+                limit = 40_000
+                if adv in ("equivocate", "vote-storm"):
+                    # never-draining queues (equivocator re-proposals) /
+                    # multi-rotation storms need the longer leash
+                    limit = 60_000
                 specs.append(CellSpec(
                     shape=shape, adversary=adv, n=4, seed=seed,
                     time_scale=SIM_SCALES.get(shape, 1e-3),
@@ -366,6 +464,13 @@ def full_grid(seeds: Sequence[int] = (0, 1),
     for i in range(churn_cells):
         specs.append(CellSpec(kind="churn", shape="none",
                               adversary="null", n=4, seed=i))
+    # WAN-shape cells against the PIPELINED socket cluster (ROADMAP item
+    # 1 meets item 4): real transport, real chaos preset timings, epochs
+    # kept in flight — the trajectory's second liveness point
+    for shape in ("wan-100ms", "dup-reorder", "lossy-1pct"):
+        specs.append(CellSpec(kind="socket", shape=shape,
+                              adversary="null", n=4, seed=0,
+                              pipeline_depth=2))
     return specs
 
 
@@ -442,6 +547,8 @@ def run_campaign(specs: Sequence[CellSpec], journal_root: str,
         try:
             if spec.kind == "churn":
                 detail, res = run_churn_cell(spec, cell_dir)
+            elif spec.kind == "socket":
+                detail, res = run_socket_cell(spec, cell_dir)
             else:
                 detail, res = run_cell(spec, cell_dir)
         except Exception as exc:

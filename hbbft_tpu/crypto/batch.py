@@ -732,6 +732,58 @@ def commitment_eval(bivar_com, x: int, y: int):
     return acc
 
 
+def poly_eval_range(coeffs, n: int):
+    """``[f(1), …, f(n)]`` for the Fr polynomial with ``coeffs`` — the
+    Shamir share-evaluation inner loop of the DKG (every dealer evaluates
+    its row polynomial at all node indices; every acker re-evaluates).
+
+    Consecutive evaluation points admit the finite-difference scheme from
+    "An efficient implementation of the Shamir secret sharing scheme"
+    (PAPERS.md): seed the difference table with ``deg+1`` Horner
+    evaluations, then every further share costs ``deg`` modular
+    *additions* instead of ``deg`` modular multiplications.
+    """
+    from hbbft_tpu.crypto.tc import R
+
+    def horner(x: int) -> int:
+        acc = 0
+        for coef in reversed(coeffs):
+            acc = (acc * x + coef) % R
+        return acc
+
+    deg = len(coeffs) - 1
+    if n <= deg + 1:
+        return [horner(x) for x in range(1, n + 1)]
+    seed = [horner(x) for x in range(1, deg + 2)]
+    # forward-difference tails: tail[k] = Δᵏf at the newest point
+    table = [list(seed)]
+    for _ in range(deg):
+        prev = table[-1]
+        table.append([(prev[i + 1] - prev[i]) % R
+                      for i in range(len(prev) - 1)])
+    tail = [row[-1] for row in table]
+    out = seed
+    for _ in range(n - (deg + 1)):
+        for k in reversed(range(deg)):
+            tail[k] = (tail[k] + tail[k + 1]) % R
+        out.append(tail[0])
+    return out
+
+
+def bivar_rows_range(bivar_poly, n: int):
+    """``[bivar_poly.row(1), …, bivar_poly.row(n)]`` via per-column
+    finite differences (see :func:`poly_eval_range`) — the dealer-side
+    share loop of :meth:`SyncKeyGen.generate_part`."""
+    from hbbft_tpu.crypto.tc import Poly
+
+    t1 = bivar_poly.degree() + 1
+    cols = [
+        poly_eval_range([bivar_poly.coeffs[i][j] for i in range(t1)], n)
+        for j in range(t1)
+    ]
+    return [Poly([cols[j][x] for j in range(t1)]) for x in range(n)]
+
+
 def bivar_commitment(bivar_poly):
     """``BivarPoly.commitment()`` with automatic device batching (fixed-base
     g1^coeff for all (t+1)² coefficients)."""

@@ -433,19 +433,28 @@ def g1_neg(pt):
     return (pt[0], -pt[1] % P, pt[2])
 
 
+# Scalars at or below this bit length skip the native oracle: a native
+# scalar-mul pays a fixed serialize/ladder/deserialize cost (~130 µs even
+# for k=3), while a Python double-and-add costs ~5 µs per group op — so a
+# 12-bit scalar (≤ 18 ops) is an order of magnitude cheaper in Python.
+# DKG evaluation points are node indices (x = i+1 ≤ N), which is what
+# makes Horner-form commitment evaluation fast (see tc.BivarCommitment).
+SMALL_SCALAR_BITS = 12
+
+
 def g1_mul(pt, k: int):
     k %= R
     nat = _native()
-    if nat is not None and pt is not None:
-        return _g1_from_bytes_trusted(nat.bls_g1_mul(g1_to_bytes(pt), k))
-    result = None
-    add = pt
-    while k:
-        if k & 1:
-            result = g1_add(result, add)
-        add = g1_double(add)
-        k >>= 1
-    return result
+    if 0 < k < (1 << SMALL_SCALAR_BITS) or nat is None or pt is None:
+        result = None
+        add = pt
+        while k:
+            if k & 1:
+                result = g1_add(result, add)
+            add = g1_double(add)
+            k >>= 1
+        return result
+    return _g1_from_bytes_trusted(nat.bls_g1_mul(g1_to_bytes(pt), k))
 
 
 def g1_affine(pt):

@@ -550,14 +550,21 @@ class BivarCommitment:
         return self.degree_
 
     def evaluate(self, x: int, y: int):
+        # Horner in both variables: every scalar-mul is by the evaluation
+        # point itself, never by a full-width power — and DKG evaluation
+        # points are node indices, which g1_mul's small-scalar fast path
+        # turns into a handful of Python group ops each
         acc = None
-        xp = 1
-        for i in range(self.degree_ + 1):
-            yp = 1
-            for j in range(self.degree_ + 1):
-                acc = c.g1_add(acc, c.g1_mul(self.points[i][j], xp * yp % R))
-                yp = yp * y % R
-            xp = xp * x % R
+        for i in reversed(range(self.degree_ + 1)):
+            row_acc = None
+            for j in reversed(range(self.degree_ + 1)):
+                row_acc = c.g1_add(
+                    c.g1_mul(row_acc, y) if row_acc is not None else None,
+                    self.points[i][j],
+                )
+            acc = c.g1_add(
+                c.g1_mul(acc, x) if acc is not None else None, row_acc
+            )
         return acc
 
     def row(self, x: int) -> Commitment:
@@ -565,11 +572,13 @@ class BivarCommitment:
             return Commitment(list(self.points[0]))
         out = []
         for j in range(self.degree_ + 1):
+            # Horner over i: muls are by x itself (small for node indices)
             acc = None
-            xp = 1
-            for i in range(self.degree_ + 1):
-                acc = c.g1_add(acc, c.g1_mul(self.points[i][j], xp))
-                xp = xp * x % R
+            for i in reversed(range(self.degree_ + 1)):
+                acc = c.g1_add(
+                    c.g1_mul(acc, x) if acc is not None else None,
+                    self.points[i][j],
+                )
             out.append(acc)
         return Commitment(out)
 

@@ -22,7 +22,11 @@ loses a task, or blocks the loop stalls consensus for the whole node.
   ``open_connection``, ``wait_for`` around those …).  A peer that stops
   reading wedges the awaiting task *while it holds the lock*, starving
   every other task that needs it — the deadlock shape the transport's
-  heartbeat logic documents.
+  heartbeat logic documents.  ``net/statesync.py`` is in scope like the
+  rest of ``net/``: a snapshot transfer awaiting a stalled donor must
+  never hold a lock (the client is written lock-free — sequential
+  request/response with per-request deadlines — and this rule keeps it
+  that way).
 - ``pump-inline-crypto`` — a direct ``pairing*`` / share-verify /
   share-generation call in the scheduler module (``net/scheduler.py``).
   The pump's contract is that ALL threshold crypto flows through the

@@ -20,7 +20,12 @@ a swallowed exception is an invisible Byzantine symptom.
   ``hbbft_obs_flight_write_failures_total`` (and friends).  ``chaos/``
   is in scope since the campaign runner: shaped-away frames must count
   ``hbbft_chaos_frames_dropped_total`` and a failed cell must land in
-  the report's error tally, never vanish.
+  the report's error tally, never vanish.  ``net/statesync.py`` is in
+  scope since the membership lifecycle landed: every failed chunk is a
+  counted retry (``hbbft_sync_chunk_retries_total``), every donor
+  switch a counted failover, and an abandoned transfer must count
+  ``hbbft_sync_transfers_abandoned_total`` — a joiner that silently
+  gives up is a wedged validator.
 """
 
 from __future__ import annotations

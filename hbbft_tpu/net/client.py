@@ -28,9 +28,7 @@ from hbbft_tpu.net import framing, transport
 from hbbft_tpu.net.framing import (
     DEFAULT_MAX_FRAME,
     FrameDecoder,
-    FrameError,
     Hello,
-    ROLE_CLIENT,
 )
 
 
@@ -207,24 +205,13 @@ class ClusterClient:
     # -- lifecycle -----------------------------------------------------------
 
     async def connect(self) -> Hello:
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(*self.addr), self.connect_timeout_s
+        reader, writer, node_hello = await framing.client_hello_handshake(
+            self.addr, self.cluster_id, self.client_id,
+            timeout_s=self.connect_timeout_s, max_frame=self.max_frame,
         )
         transport.set_nodelay(writer)
         self._reader, self._writer = reader, writer
-        hello = Hello(node_id=self.client_id, role=ROLE_CLIENT,
-                      cluster_id=self.cluster_id, era=0, epoch=0)
-        writer.write(framing.encode_frame(
-            framing.HELLO, framing.encode_hello(hello), self.max_frame
-        ))
-        await writer.drain()
-        kind, payload = await asyncio.wait_for(
-            framing.read_one_frame(reader, self.max_frame),
-            self.connect_timeout_s,
-        )
-        if kind != framing.HELLO:
-            raise FrameError("node did not answer with HELLO")
-        self.node_hello = framing.decode_hello(payload)
+        self.node_hello = node_hello
         loop = asyncio.get_running_loop()
         self._reader_task = loop.create_task(
             self._recv_loop(), name=f"client-{self.client_id}"

@@ -55,6 +55,15 @@ class ClusterConfig:
     heartbeat_s: float = 0.5
     dead_after_s: float = 3.0
     replay_retain_epochs: int = 64
+    # bounded storage: per-peer replay-log byte ceiling (0 = epochs-only
+    # retention) and flight-journal checkpoint retention in committed
+    # batches (0 = segment-count cap only).  Truncations are counted
+    # (`hbbft_node_replay_truncations_total`,
+    # `hbbft_obs_flight_truncations_total`) and visible in /status.
+    replay_retain_bytes: int = 0
+    flight_retain_batches: int = 0
+    # snapshot state-sync transfer chunk size (net/statesync.py)
+    sync_chunk_bytes: int = 32 * 1024
     # obs endpoint (/metrics /status /spans /flight) base port: node i
     # serves on metrics_base_port + i; 0 → no fixed obs ports
     # (LocalCluster still opens ephemeral ones)
@@ -185,6 +194,32 @@ def generate_infos(cfg: ClusterConfig) -> Dict[int, NetworkInfo]:
     )
 
 
+def node_secret_key(cfg: ClusterConfig, nid: int,
+                    infos: Optional[Dict[int, NetworkInfo]] = None):
+    """Node ``nid``'s plain BLS secret key under this config.  Genesis
+    members (``nid < cfg.n``) use their generated keypair; later joiners
+    derive a fresh deterministic keypair from the cluster seed — the
+    public half is what existing validators vote in."""
+    from hbbft_tpu.crypto import tc
+
+    if nid < cfg.n:
+        if infos is None:
+            infos = generate_infos(cfg)
+        return infos[nid].secret_key()
+    return tc.SecretKey.random(
+        random.Random(cfg.seed * 100_000 + 9000 + nid))
+
+
+def peer_addr_book(cfg: ClusterConfig):
+    """The deployment address book: membership says WHO may join
+    (consensus state); this says WHERE a member listens (config-derived
+    ports).  Only meaningful with fixed ports."""
+    if cfg.base_port == 0:
+        return None
+    return lambda nid: ((cfg.host, cfg.base_port + nid)
+                        if isinstance(nid, int) and nid >= 0 else None)
+
+
 def build_algo(cfg: ClusterConfig, infos: Dict[int, NetworkInfo],
                nid: int) -> SenderQueue:
     """The standard node stack: SenderQueue(QHB(DHB)) with per-node seeded
@@ -205,27 +240,65 @@ def build_algo(cfg: ClusterConfig, infos: Dict[int, NetworkInfo],
     return SenderQueue(qhb)
 
 
-def build_runtime(cfg: ClusterConfig, infos: Dict[int, NetworkInfo],
-                  nid: int, **kwargs) -> NodeRuntime:
-    kwargs.setdefault("shaper", cfg.chaos_shaper_for(nid))
-    return NodeRuntime(
-        build_algo(cfg, infos, nid),
-        cfg.cluster_id,
+def _shared_runtime_kwargs(cfg: ClusterConfig, nid: int) -> dict:
+    return dict(
         seed=cfg.seed * 1000 + nid,
         heartbeat_s=cfg.heartbeat_s,
         dead_after_s=cfg.dead_after_s,
         replay_retain_epochs=cfg.replay_retain_epochs,
+        replay_retain_bytes=cfg.replay_retain_bytes,
+        flight_retain_batches=cfg.flight_retain_batches,
+        sync_chunk_bytes=cfg.sync_chunk_bytes,
+        peer_addr_book=peer_addr_book(cfg),
         digest_chain_retain=cfg.digest_chain_retain,
         flight_dir=cfg.node_flight_dir(nid),
         flight_max_segment_bytes=cfg.flight_max_segment_bytes,
         flight_max_segments=cfg.flight_max_segments,
         pipeline_depth=cfg.pipeline_depth,
-        link_delays=cfg.link_delays_for(nid),
         step_delay_s=cfg.step_delay_for(nid),
         aba_out_delay_s=cfg.aba_delay_for(nid),
         aba_out_classes=cfg.aba_out_classes,
-        **kwargs,
     )
+
+
+def build_runtime(cfg: ClusterConfig, infos: Dict[int, NetworkInfo],
+                  nid: int, **kwargs) -> NodeRuntime:
+    kwargs.setdefault("shaper", cfg.chaos_shaper_for(nid))
+    merged = _shared_runtime_kwargs(cfg, nid)
+    merged["link_delays"] = cfg.link_delays_for(nid)
+    merged.update(kwargs)
+    return NodeRuntime(build_algo(cfg, infos, nid), cfg.cluster_id,
+                       **merged)
+
+
+def build_joiner_runtime(cfg: ClusterConfig, snap, nid: int,
+                         **kwargs) -> NodeRuntime:
+    """A runtime activated from a state-sync :class:`JoinSnapshot`
+    instead of genesis config: the standard node stack built via
+    ``snapshot.build_joiner`` (DKG-transcript share derivation included)
+    with the ledger-digest chain seeded at the snapshot's era boundary.
+
+    Works for brand-new validators (``nid ≥ cfg.n``) and for genesis
+    members rejoining after an outage that outlived replay retention
+    (their config netinfo backs share derivation across
+    encryption-schedule rotations)."""
+    from hbbft_tpu.snapshot import build_joiner
+
+    infos = generate_infos(cfg)
+    sq = build_joiner(
+        snap, nid, node_secret_key(cfg, nid, infos),
+        batch_size=cfg.batch_size,
+        rng_seed=cfg.seed * 100_000 + 7000 + nid,
+        config_netinfo=infos.get(nid),
+    )
+    # same egress shaping as a genesis member: a joiner in a
+    # chaos-configured cluster is NOT exempt from the chaos
+    kwargs.setdefault("shaper", cfg.chaos_shaper_for(nid))
+    merged = _shared_runtime_kwargs(cfg, nid)
+    merged["link_delays"] = cfg.link_delays_for(nid)
+    merged["ledger_seed"] = (snap.chain_head, snap.chain_len)
+    merged.update(kwargs)
+    return NodeRuntime(sq, cfg.cluster_id, **merged)
 
 
 # -- in-process cluster ------------------------------------------------------
@@ -292,6 +365,117 @@ class LocalCluster:
              if self.cfg.metrics_base_port else 0),
         )
         rt.connect(self.addrs)
+
+    def vote_change(self, change) -> None:
+        """Queue the same signed membership vote on every live runtime
+        (votes commit through contributions; a majority rotates the
+        era)."""
+        from hbbft_tpu.protocols.dynamic_honey_badger import ChangeInput
+
+        for rt in self.runtimes:
+            rt.pump.enqueue("input", ChangeInput(change))
+
+    def vote_to_add(self, nid: int) -> None:
+        """Every validator votes to add ``nid`` (its config-derived
+        public key) to the validator set."""
+        from hbbft_tpu.protocols.dynamic_honey_badger import Change
+
+        pk = node_secret_key(self.cfg, nid, self._infos).public_key()
+        keys = dict(
+            self.runtimes[0].sq.algo.dhb.netinfo.public_key_map())
+        keys[nid] = pk
+        self.vote_change(Change.node_change(keys))
+
+    def vote_to_readd(self) -> None:
+        """Vote a node-change to the CURRENT key map: a no-op membership
+        change that still runs a full DKG and rotates the era — the
+        checkpoint rotation that re-arms snapshot joins with a fresh
+        transcript (how a restarted-beyond-retention validator gets a
+        boundary to recover through)."""
+        from hbbft_tpu.protocols.dynamic_honey_badger import Change
+
+        keys = dict(
+            self.runtimes[0].sq.algo.dhb.netinfo.public_key_map())
+        self.vote_change(Change.node_change(keys))
+
+    async def wait_snapshot(self, min_era: int,
+                            timeout_s: float = 60.0) -> None:
+        """Until every live runtime serves a join snapshot of era ≥
+        ``min_era`` (i.e. the voted rotation completed everywhere)."""
+
+        async def _wait():
+            while any(
+                rt.sync_store.manifest is None
+                or rt.sync_store.manifest.era < min_era
+                for rt in self.runtimes
+            ):
+                await asyncio.sleep(0.02)
+
+        await asyncio.wait_for(_wait(), timeout_s)
+
+    async def join_node(self, nid: int, *, timeout_s: float = 90.0,
+                        donors: Optional[List[int]] = None
+                        ) -> NodeRuntime:
+        """The full membership-lifecycle join: vote ``nid`` in, wait for
+        the DKG rotation, state-sync the boundary snapshot from donors,
+        activate the joiner (share-complete, zero history replay), and
+        wire it into the cluster.  Requires fixed ports
+        (``cfg.base_port``)."""
+        if not self.cfg.base_port:
+            raise ValueError("join_node needs fixed ports "
+                             "(ClusterConfig.base_port)")
+        self.vote_to_add(nid)
+        min_era = max(rt.current_key()[0] for rt in self.runtimes) + 1
+        await self.wait_snapshot(min_era, timeout_s)
+        return await self.activate_from_snapshot(
+            nid, donors=donors, min_manifest_confirm=2)
+
+    async def activate_from_snapshot(
+        self, nid: int, *, donors: Optional[List[int]] = None,
+        min_manifest_confirm: int = 1,
+    ) -> NodeRuntime:
+        """State-sync ``nid`` from live donors and start it — the shared
+        tail of a brand-new join and a restarted-beyond-retention
+        recovery."""
+        from hbbft_tpu.net.statesync import StateSyncClient
+
+        from hbbft_tpu.obs.metrics import Registry
+
+        donor_addrs = [self.addrs[d] for d in (donors or
+                       [d for d in self.addrs if d != nid])]
+        # the bootstrap transfer's counters live on the SAME registry the
+        # runtime will serve on /metrics — the join story stays scrapeable
+        registry = self.runtime_kwargs.get("registry") or Registry()
+        snap = await StateSyncClient(
+            donor_addrs, self.cfg.cluster_id,
+            client_id=f"statesync-{nid}", seed=self.cfg.seed,
+            min_manifest_confirm=min_manifest_confirm,
+            registry=registry,
+        ).fetch()
+        kwargs = dict(self.runtime_kwargs)
+        kwargs["registry"] = registry
+        # DKG-transcript replay (BLS row decryption + commitment checks)
+        # is CPU-heavy sync work — off the event loop, or the donors
+        # sharing this loop would miss heartbeats mid-join
+        rt = await asyncio.to_thread(
+            build_joiner_runtime, self.cfg, snap, nid, **kwargs)
+        addr = (self.cfg.host, self.cfg.base_port + nid)
+        if nid < len(self.runtimes):
+            self.runtimes[nid] = rt
+        else:
+            self.runtimes.append(rt)
+        self.addrs[nid] = addr
+        await rt.start(*addr)
+        self.metrics_addrs[nid] = await rt.start_obs(
+            self.cfg.host,
+            (self.cfg.metrics_base_port + nid
+             if self.cfg.metrics_base_port else 0),
+        )
+        # the joiner dials every existing member; members accept its
+        # hello through the membership-resolved dynamic-peer path and
+        # dial back (transport.peer_resolver)
+        rt.connect(dict(self.addrs))
+        return rt
 
     async def client(self, nid: int,
                      client_id: str = "client") -> ClusterClient:

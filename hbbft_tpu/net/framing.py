@@ -46,11 +46,14 @@ TX_COMMIT = 0x07   # node → client: era/epoch + committed tx digests
 STATUS_REQ = 0x08  # client → node: empty
 STATUS = 0x09      # node → client: JSON status document
 MSG_BATCH = 0x0A   # several MSG payloads coalesced into one frame
+SYNC = 0x0B        # snapshot state-sync record (net/statesync.py), both
+                   # directions on a client-role connection; payload is
+                   # wire.encode_message bytes of a Sync* record
 
 KIND_NAMES = {
     HELLO: "HELLO", MSG: "MSG", PING: "PING", PONG: "PONG", TX: "TX",
     TX_ACK: "TX_ACK", TX_COMMIT: "TX_COMMIT", STATUS_REQ: "STATUS_REQ",
-    STATUS: "STATUS", MSG_BATCH: "MSG_BATCH",
+    STATUS: "STATUS", MSG_BATCH: "MSG_BATCH", SYNC: "SYNC",
 }
 
 # TX_ACK status bytes
@@ -187,6 +190,39 @@ async def read_one_frame(reader, max_frame: int = DEFAULT_MAX_FRAME
         )
     body = await reader.readexactly(body_len)
     return body[0], body[1:]
+
+
+async def client_hello_handshake(
+    addr, cluster_id: bytes, client_id, *,
+    timeout_s: float, max_frame: int = DEFAULT_MAX_FRAME,
+):
+    """Dial ``addr``, perform the client-role HELLO exchange, and return
+    ``(reader, writer, node_hello)`` — the one handshake shared by every
+    client-side connection (``ClusterClient``, the state-sync joiner).
+    Raises :class:`FrameError` on a non-HELLO reply or cluster-id
+    mismatch; timeouts/connection errors propagate."""
+    import asyncio
+
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(*addr), timeout_s
+    )
+    try:
+        hello = Hello(node_id=client_id, role=ROLE_CLIENT,
+                      cluster_id=cluster_id, era=0, epoch=0)
+        writer.write(encode_frame(HELLO, encode_hello(hello), max_frame))
+        await writer.drain()
+        kind, payload = await asyncio.wait_for(
+            read_one_frame(reader, max_frame), timeout_s
+        )
+        if kind != HELLO:
+            raise FrameError("node did not answer with HELLO")
+        node_hello = decode_hello(payload)
+        if node_hello.cluster_id != cluster_id:
+            raise FrameError("cluster id mismatch")
+    except BaseException:
+        writer.close()
+        raise
+    return reader, writer, node_hello
 
 
 # -- hello -------------------------------------------------------------------

@@ -50,7 +50,9 @@ from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
 from hbbft_tpu.net import framing
 from hbbft_tpu.net.client import Mempool, tx_digest
 from hbbft_tpu.net.scheduler import StepPump
+from hbbft_tpu.net.statesync import SnapshotStore
 from hbbft_tpu.net.transport import ClientConn, Transport
+from hbbft_tpu.snapshot import capture_join_snapshot
 from hbbft_tpu.obs.flight import FlightObserver, FlightRecorder
 from hbbft_tpu.obs.http import ObsServer
 from hbbft_tpu.obs.metrics import MetricAttr, Registry, fault_counter
@@ -116,6 +118,7 @@ class NodeRuntime:
         mempool: Optional[Mempool] = None,
         make_tx_input: Callable[[bytes], Any] = TxInput,
         replay_retain_epochs: int = 64,
+        replay_retain_bytes: int = 0,
         on_batch: Optional[Callable[[Any], None]] = None,
         trace=None,
         cost_model=None,
@@ -124,6 +127,12 @@ class NodeRuntime:
         flight_dir: Optional[str] = None,
         flight_max_segment_bytes: int = 4 * 2**20,
         flight_max_segments: int = 16,
+        flight_retain_batches: int = 0,
+        ledger_seed: Optional[Tuple[bytes, int]] = None,
+        sync_chunk_bytes: int = 32 * 1024,
+        peer_addr_book: Optional[
+            Callable[[NodeId], Optional[Addr]]
+        ] = None,
         pipeline_depth: int = 1,
         step_delay_s: float = 0.0,
         aba_out_delay_s: float = 0.0,
@@ -178,7 +187,12 @@ class NodeRuntime:
         self._c_replay_gaps = self.registry.counter(
             "hbbft_node_replay_gaps_total",
             "peer restarts whose gap exceeded replay retention "
-            "(the peer cannot catch up from here)")
+            "(the peer cannot catch up from here; remedy: snapshot "
+            "state-sync — net/statesync.py)")
+        self._c_replay_trunc = self.registry.counter(
+            "hbbft_node_replay_truncations_total",
+            "replay-log entries truncated by the byte cap "
+            "(replay_retain_bytes) before their epoch retention expired")
         self._c_committed = self.registry.counter(
             "hbbft_node_committed_txs_total", "transactions committed")
         self._c_faults = fault_counter(self.registry)
@@ -201,9 +215,21 @@ class NodeRuntime:
                 )
         self.make_tx_input = make_tx_input
         self.replay_retain_epochs = replay_retain_epochs
+        # bounded storage: a per-peer byte ceiling on the replay log that
+        # truncates EARLIER than the epoch retention when a peer's
+        # backlog grows fat (0 = epochs-only).  Truncated entries are
+        # counted — a peer whose gap now exceeds what the log covers
+        # recovers via snapshot state-sync instead of replay.
+        self.replay_retain_bytes = int(replay_retain_bytes)
+        self.flight_retain_batches = int(flight_retain_batches)
         self.on_batch = on_batch
         self.batches: List[Any] = []
         self.ledger_digest = b"\x00" * 32
+        # era-boundary join snapshots: captured at every completed DKG
+        # rotation, served to joiners over SYNC client frames
+        self.sync_store = SnapshotStore(self.registry,
+                                        chunk_bytes=sync_chunk_bytes)
+        self.peer_addr_book = peer_addr_book
         # the digest chain is CHECKPOINTED, not unbounded: only the last
         # `digest_chain_retain` entries stay in memory; `chain_len` (the
         # total) and `ledger_digest` (the head) never truncate, and the
@@ -224,6 +250,24 @@ class NodeRuntime:
             )
             self.flight = FlightObserver(recorder)
             self.spans.sink = self.flight.record_span
+        # snapshot state-sync activation: continue the ledger-digest
+        # chain from the snapshot's era boundary instead of genesis.
+        # The flight journal is seeded with the same position and notes
+        # the boundary so the forensic auditor can verify the join
+        # against the donors' chains (obs.audit).
+        if ledger_seed is not None:
+            head, chain_len = ledger_seed
+            if len(head) != 32 or chain_len < 0:
+                raise ValueError("ledger_seed must be (32-byte head, len)")
+            self.ledger_digest = bytes(head)
+            self._digest_chain_offset = int(chain_len)
+            if self.flight is not None:
+                self.flight.seed_chain(self.ledger_digest,
+                                       self._digest_chain_offset)
+                self.flight.on_note(
+                    "statesync",
+                    f"index={self._digest_chain_offset} "
+                    f"head={self.ledger_digest.hex()}")
         # per-peer replay log of recently sent consensus messages, in send
         # order: the reinit_peer history (see module docstring).  Entries
         # are (key, message, payload) — the companion set dedups on
@@ -232,6 +276,7 @@ class NodeRuntime:
         # chains recursively was a measurable slice of _dispatch)
         self._replay: Dict[NodeId, List[Tuple[EpochKey, Any, bytes]]] = {}
         self._replay_seen: Dict[NodeId, set] = {}
+        self._replay_bytes: Dict[NodeId, int] = {}
         self._clients: set = set()
         self.transport = Transport(
             our_id=self.sq.our_id(),
@@ -245,6 +290,7 @@ class NodeRuntime:
             trace=trace,
             cost_model=cost_model,
             registry=self.registry,
+            peer_resolver=self._resolve_peer,
             **transport_kwargs,
         )
         self._obs_server: Optional[ObsServer] = None
@@ -348,6 +394,26 @@ class NodeRuntime:
         dhb = getattr(algo, "dhb", algo)
         return getattr(dhb, "hb", dhb if isinstance(dhb, HoneyBadger)
                        else None)
+
+    def _inner_dhb(self):
+        """The DynamicHoneyBadger of the wrapped stack, if any."""
+        algo = self.sq.algo
+        dhb = getattr(algo, "dhb", algo)
+        return dhb if isinstance(dhb, DynamicHoneyBadger) else None
+
+    def _resolve_peer(self, node_id: NodeId) -> Optional[Addr]:
+        """Transport hook: may an unknown node-role hello join the peer
+        set, and at what address?  Membership is consensus state — a
+        node the current era's validator map names (e.g. one voted in by
+        a DHB rotation) is accepted, everyone else stays rejected.  The
+        address comes from the deployment's address book
+        (config-derived ports for the shipped cluster tooling)."""
+        if self.peer_addr_book is None or node_id == self.our_id():
+            return None
+        dhb = self._inner_dhb()
+        if dhb is None or node_id not in dhb.netinfo.public_key_map():
+            return None
+        return self.peer_addr_book(node_id)
 
     async def start_obs(self, host: str = "127.0.0.1",
                         port: int = 0) -> Addr:
@@ -728,6 +794,9 @@ class NodeRuntime:
                         self._replay.setdefault(dest, []).append(
                             (key, msg.msg, payload)
                         )
+                        self._replay_bytes[dest] = (
+                            self._replay_bytes.get(dest, 0) + len(payload)
+                        )
 
     def _prune_replay(self) -> None:
         era, epoch = self.current_key()
@@ -741,21 +810,42 @@ class NodeRuntime:
             # last `retain` epochs while that era was current) until this
             # era is `retain` epochs old.
             floor = (era - 1, 0) if era > 0 else (0, 0)
+        cap = self.replay_retain_bytes
         for dest, entries in self._replay.items():
+            i = 0
             if entries and entries[0][0] < floor:
                 # entries are appended in send order (keys non-decreasing
                 # modulo reinit merges), so pruning is a front chop —
                 # incremental, not a full list+set rebuild per epoch
-                i = 0
                 n = len(entries)
                 while i < n and entries[i][0] < floor:
                     i += 1
-                if i:
-                    seen = self._replay_seen.get(dest)
-                    if seen is not None:
-                        for k, _m, p in entries[:i]:
-                            seen.discard((k, p))
-                    del entries[:i]
+            if cap > 0 and self._replay_bytes.get(dest, 0) > cap:
+                # byte ceiling (bounded storage): keep chopping the
+                # oldest entries past the epoch floor until the peer's
+                # log fits — measured AFTER crediting what the epoch
+                # floor is already removing, so the cap never truncates
+                # more than it must.  Chopped entries are counted — they
+                # were still inside epoch retention, so a peer that
+                # needed them must recover via snapshot state-sync.
+                floor_bytes = sum(len(p) for _k, _m, p in entries[:i])
+                over = self._replay_bytes[dest] - floor_bytes - cap
+                j = i
+                n = len(entries)
+                while j < n and over > 0:
+                    over -= len(entries[j][2])
+                    j += 1
+                if j > i:
+                    self._c_replay_trunc.inc(j - i)
+                    i = j
+            if i:
+                seen = self._replay_seen.get(dest)
+                if seen is not None:
+                    for k, _m, p in entries[:i]:
+                        seen.discard((k, p))
+                self._replay_bytes[dest] = self._replay_bytes.get(
+                    dest, 0) - sum(len(p) for _k, _m, p in entries[:i])
+                del entries[:i]
 
     # -- batches & clients ---------------------------------------------------
 
@@ -769,6 +859,33 @@ class NodeRuntime:
             drop = len(self._digest_chain) - self.digest_chain_retain
             del self._digest_chain[:drop]
             self._digest_chain_offset += drop
+        change = getattr(batch, "change", None)
+        if change is not None and change.state == "complete":
+            # a DKG rotation just landed: this instant — the new era's
+            # boundary, before any of its epochs complete — is the only
+            # moment join_plan() is valid.  Package it with the committed
+            # DKG transcript and the chain position as the served join
+            # snapshot.
+            dhb = self._inner_dhb()
+            if dhb is not None:
+                try:
+                    self.sync_store.publish(capture_join_snapshot(
+                        dhb, self.ledger_digest, self.chain_len))
+                except ValueError as exc:
+                    # a replayed future-era message already completed an
+                    # epoch of the new era inside this same step — the
+                    # boundary passed before we saw it.  Counted: joiners
+                    # must wait for the next rotation.
+                    self.sync_store._c_capture_misses.inc()
+                    logger.warning("join snapshot not captured at era "
+                                   "%d boundary: %s", dhb.era, exc)
+        if (self.flight_retain_batches > 0 and self.flight is not None
+                and self.chain_len % 16 == 0):
+            # bounded storage: drop whole journal segments that lie
+            # entirely below the digest-chain checkpoint horizon (the
+            # chain head + /status cover the truncated history)
+            self.flight.recorder.truncate_checkpoint(
+                self.chain_len - self.flight_retain_batches)
         if isinstance(batch, QhbBatch):
             txs = batch.all_txs()
             self._c_committed.inc(len(txs))
@@ -793,6 +910,23 @@ class NodeRuntime:
 
     def _on_client_frame(self, conn: ClientConn, kind: int,
                          payload: bytes) -> None:
+        if kind == framing.SYNC:
+            # snapshot state-sync (joiner bootstrap): request → reply on
+            # this connection, WITHOUT registering it for commit pushes —
+            # a transferring joiner wants chunks, not TX_COMMIT noise
+            try:
+                msg = wire.decode_message(payload)
+            except ValueError as exc:
+                from hbbft_tpu.net.statesync import SyncNack
+
+                self.sync_store._c_nacks.inc()
+                logger.warning("undecodable sync request: %s", exc)
+                conn.send(framing.SYNC,
+                          wire.encode_message(SyncNack("bad request")))
+                return
+            conn.send(framing.SYNC,
+                      wire.encode_message(self.sync_store.handle(msg)))
+            return
         self._clients.add(conn)
         if kind == framing.TX:
             # admission (bounded, dedup'd) and the ack stay on the event
@@ -839,6 +973,16 @@ class NodeRuntime:
             "decode_failures": self.decode_failures,
             "send_failures": self.send_failures,
             "replay_gaps": self.replay_gaps,
+            "replay_truncations": int(self._c_replay_trunc.total()),
+            "replay_log_bytes": sum(self._replay_bytes.values()),
+            "sync_snapshot": (
+                {
+                    "era": self.sync_store.manifest.era,
+                    "chain_len": self.sync_store.manifest.chain_len,
+                    "image_len": self.sync_store.manifest.image_len,
+                }
+                if self.sync_store.manifest is not None else None
+            ),
             "faults_observed": self.faults_observed,
             "peers_connected": sum(
                 1 for p in self.transport.peer_ids()

@@ -205,6 +205,10 @@ class TransportStats:
             "hbbft_net_client_conn_drops_total",
             "client connections dropped mid-send (write-buffer overflow "
             "or dead socket)")
+        self._dynamic_peers = r.counter(
+            "hbbft_net_dynamic_peers_total",
+            "peers added live from a membership-resolved inbound hello "
+            "(a validator voted in by a DHB rotation dialing us)")
         # virtual cost of received traffic under the attached CostModel —
         # the simulator's synthetic clock applied to real frames, so sim
         # and net runs report comparable virtual time
@@ -244,6 +248,7 @@ class TransportStats:
     dead_peer_events = MetricAttr("_dead_peer_events")
     inbound_drops = MetricAttr("_inbound_drops")
     client_conn_drops = MetricAttr("_client_conn_drops")
+    dynamic_peers = MetricAttr("_dynamic_peers")
     virtual_cost_s = MetricAttr("_virtual_cost", cast=float)
 
     def record_backoff(self, peer_id: NodeId, delay: float) -> None:
@@ -261,6 +266,7 @@ class TransportStats:
             "dead_peer_events": self.dead_peer_events,
             "inbound_drops": self.inbound_drops,
             "client_conn_drops": self.client_conn_drops,
+            "dynamic_peers": self.dynamic_peers,
             "virtual_cost_s": round(self.virtual_cost_s, 6),
         }
 
@@ -581,6 +587,9 @@ class Transport:
         registry=None,
         link_delays: Optional[Dict[NodeId, float]] = None,
         shaper=None,
+        peer_resolver: Optional[
+            Callable[[NodeId], Optional[Addr]]
+        ] = None,
     ):
         self.our_id = our_id
         self.cluster_id = bytes(cluster_id)
@@ -597,6 +606,13 @@ class Transport:
         self.backoff = backoff or BackoffPolicy(seed=seed)
         self.trace = trace
         self.cost_model = cost_model
+        # dynamic membership: an inbound node-role hello from an id
+        # OUTSIDE the configured peer set is normally rejected; with a
+        # resolver, the embedder (NodeRuntime) is asked whether the id is
+        # a legitimate cluster member now (e.g. a validator voted in by a
+        # DHB rotation) and at what address — if it answers, the peer is
+        # added live and the connection proceeds
+        self.peer_resolver = peer_resolver
         self.stats = TransportStats(registry)
         # outbound link shaping — the real-socket side of the shared
         # chaos.link hook: per-directed-edge latency/jitter/loss/dup/
@@ -750,9 +766,16 @@ class Transport:
         if hello.cluster_id != self.cluster_id:
             raise FrameError("cluster id mismatch")
         if hello.role == ROLE_NODE and hello.node_id not in self._senders:
-            raise FrameError(
-                f"node hello from unknown peer {hello.node_id!r}"
-            )
+            addr = (self.peer_resolver(hello.node_id)
+                    if self.peer_resolver is not None else None)
+            if addr is None:
+                raise FrameError(
+                    f"node hello from unknown peer {hello.node_id!r}"
+                )
+            self.stats.dynamic_peers += 1
+            logger.info("accepting new cluster member %r at %r "
+                        "(membership-resolved)", hello.node_id, addr)
+            self.add_peer(hello.node_id, addr)
         reply = framing.encode_frame(
             framing.HELLO, framing.encode_hello(self.local_hello()),
             self.max_frame,

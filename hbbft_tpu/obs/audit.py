@@ -61,6 +61,20 @@ from hbbft_tpu.protocols import wire
 _RANK = {"note": 0, "msg": 1, "commit": 2, "span": 3, "fault": 4}
 
 
+def _parse_statesync_note(detail: str) -> Optional[Dict[str, Any]]:
+    """``index=N head=HEX`` → {index, head} (the boundary a snapshot
+    joiner's runtime journals at activation)."""
+    fields = dict(
+        part.split("=", 1) for part in detail.split() if "=" in part
+    )
+    try:
+        return {"index": int(fields["index"]), "head": fields["head"]}
+    # hblint: disable=fault-swallowed-drop (accounted at the caller: a
+    # None return lands in sync_mismatches and flips the verdict to fork)
+    except (KeyError, ValueError):
+        return None
+
+
 def _digest(payload: bytes) -> str:
     return hashlib.sha3_256(payload).hexdigest()[:16]
 
@@ -174,6 +188,21 @@ class AuditResult:
     torn_tails: int = 0
     restarts: Dict[str, int] = field(default_factory=dict)
     status_mismatches: List[str] = field(default_factory=list)
+    # membership lifecycle: nodes that activated from a state-sync
+    # snapshot (the journal's ``statesync`` note declares the claimed
+    # chain boundary), with the boundary verified against every other
+    # journal's digest at the preceding index
+    sync_joins: List[Dict[str, Any]] = field(default_factory=list)
+    sync_mismatches: List[str] = field(default_factory=list)
+    # conflicting slot values that attribute cleanly to DIFFERENT
+    # incarnations of the sender (its own journal shows each value sent
+    # exactly once, by a different process life): the expected amnesia
+    # artifact of a crash-restart without persistence re-proposing into
+    # already-decided epochs — reported, but not a fault verdict.  True
+    # equivocation (two values inside one incarnation, or a value the
+    # sender never journaled sending — the tampering shape) still is.
+    restart_reproposals: List[Dict[str, Any]] = field(
+        default_factory=list)
 
     @property
     def first_affected_epoch(self) -> Optional[Tuple[int, int]]:
@@ -183,7 +212,7 @@ class AuditResult:
     @property
     def verdict(self) -> str:
         if self.first_divergence or self.self_conflicts \
-                or self.status_mismatches:
+                or self.status_mismatches or self.sync_mismatches:
             return "fork"
         if self.equivocations or self.monotonicity_violations:
             return "fault"
@@ -208,6 +237,9 @@ class AuditResult:
             "equivocations": self.equivocations,
             "first_affected_epoch": list(fa) if fa else None,
             "status_mismatches": self.status_mismatches,
+            "sync_joins": self.sync_joins,
+            "sync_mismatches": self.sync_mismatches,
+            "restart_reproposals": self.restart_reproposals,
         }
 
 
@@ -231,6 +263,10 @@ def audit(journals: List[Journal]) -> AuditResult:
     # -- walk every record: timeline + commits + equivocation slots ----------
     # slots[(sender, slot)] = {value_digest: sorted set of witness nodes}
     slots: Dict[Tuple, Dict[str, Any]] = {}
+    # the sender's own account: per slot, which incarnation(s) journaled
+    # SENDING each value — what separates a crash-restart re-proposal
+    # from equivocation/tampering
+    slot_sends: Dict[Tuple, Dict[str, set]] = {}
     commits: Dict[str, Dict[int, Tuple[str, int, int, int]]] = {}
     for j in journals:
         node = j.node
@@ -251,6 +287,21 @@ def audit(journals: List[Journal]) -> AuditResult:
                     rec.era, rec.epoch, _RANK["msg"],
                     (rec.mtype, d, 0 if rec.direction == "out" else 1,
                      node, inc, rec.seq), line))
+                if rec.direction == "out" and rec.payload:
+                    # the sender's own account of what it emitted for
+                    # each equivocation slot, tagged with the process
+                    # incarnation that sent it
+                    try:
+                        msg = wire.decode_message(rec.payload)
+                    except (ValueError, TypeError):
+                        res.decode_failures += 1
+                        continue
+                    eq = equivocation_key(msg)
+                    if eq is not None:
+                        slot, value, kind = eq
+                        slot_sends.setdefault(
+                            (node, slot, kind), {}).setdefault(
+                            _digest(value), set()).add(inc)
                 if rec.direction != "in" or not rec.payload:
                     continue
                 # match the receive to a journaled send
@@ -314,6 +365,15 @@ def audit(journals: List[Journal]) -> AuditResult:
                     0, 0, _RANK["note"],
                     ("note", rec.kind, node, inc, rec.seq),
                     f"note {rec.kind} {rec.detail} @{node}#{inc}"))
+                if rec.kind == "statesync":
+                    join = _parse_statesync_note(rec.detail)
+                    if join is None:
+                        res.sync_mismatches.append(
+                            f"{node}#{inc}: malformed statesync note "
+                            f"{rec.detail!r}")
+                    else:
+                        join.update({"node": node, "incarnation": inc})
+                        res.sync_joins.append(join)
     res.events.sort(key=lambda e: (e.era, e.epoch, e.rank, e.key))
 
     # -- digest-chain agreement ----------------------------------------------
@@ -340,6 +400,31 @@ def audit(journals: List[Journal]) -> AuditResult:
             }
             break
 
+    # -- membership-lifecycle boundaries -------------------------------------
+    # A state-sync join claims "my chain starts at index k with head H".
+    # That claim must match what the rest of the cluster committed: any
+    # journal holding index k−1 must hold digest H there.  A joiner whose
+    # claimed boundary nobody can corroborate stays unverified (benign:
+    # donors' journals may have rotated past it); a CONTRADICTED boundary
+    # is a fork.
+    for join in res.sync_joins:
+        idx, head = join["index"], join["head"]
+        verified = None
+        for other, per_index in commits.items():
+            prev = per_index.get(idx - 1)
+            if prev is None:
+                continue
+            if prev[0] == head:
+                verified = other
+            else:
+                res.sync_mismatches.append(
+                    f"{join['node']} joined claiming chain[{idx - 1}] "
+                    f"= {head[:16]} but {other} committed "
+                    f"{prev[0][:16]} there")
+                verified = None
+                break
+        join["verified_against"] = verified
+
     # -- equivocation evidence ----------------------------------------------
     eq_kinds = equivocation_kinds()
     for (sender, slot, kind), vals in sorted(
@@ -347,15 +432,41 @@ def audit(journals: List[Journal]) -> AuditResult:
         if len(vals) < 2:
             continue
         assert kind in eq_kinds
-        res.equivocations.append({
+        entry = {
             "sender": sender,
             "kind": kind.name,
             "era": slot[0],
             "epoch": slot[1],
             "slot": repr(slot),
             "values": {d: sorted(w) for d, w in sorted(vals.items())},
-        })
+        }
+        if _is_restart_reproposal(vals, slot_sends.get(
+                (sender, slot, kind))):
+            res.restart_reproposals.append(entry)
+        else:
+            res.equivocations.append(entry)
     return res
+
+
+def _is_restart_reproposal(vals: Dict[str, Any],
+                           sent: Optional[Dict[str, set]]) -> bool:
+    """Do the conflicting values attribute cleanly to different process
+    incarnations of the sender?  Requires the sender's own journal to
+    show EVERY witnessed value being sent, each by exactly one
+    incarnation, all incarnations distinct — the amnesia shape of a
+    crash-restart re-proposing into already-decided epochs.  Anything
+    less (a value the sender never journaled — tampering; two values in
+    one incarnation — equivocation; rotated-away sender evidence) stays
+    slashing-grade."""
+    if sent is None:
+        return False
+    if set(vals) - set(sent):
+        return False
+    incs = [sent[d] for d in vals]
+    if any(len(s) != 1 for s in incs):
+        return False
+    flat = [next(iter(s)) for s in incs]
+    return len(set(flat)) == len(flat)
 
 
 def cross_check_status(res: AuditResult, doc: Dict[str, Any]) -> None:
@@ -434,6 +545,18 @@ def format_report(res: AuditResult, timeline: bool = False,
     if res.equivocations:
         era, epoch = res.first_affected_epoch
         lines.append(f"first affected epoch: era={era} epoch={epoch}")
+    for e in res.restart_reproposals:
+        lines.append(f"RESTART RE-PROPOSAL (benign): {e['sender']} "
+                     f"{e['kind']} era={e['era']} epoch={e['epoch']} — "
+                     f"each value sent by a different incarnation")
+    for j in res.sync_joins:
+        v = j.get("verified_against")
+        how = (f"boundary matches {v}" if v
+               else "boundary uncorroborated — no overlapping journal")
+        lines.append(f"STATE-SYNC JOIN: {j['node']}#{j['incarnation']} "
+                     f"activated at chain index {j['index']} ({how})")
+    for m in res.sync_mismatches:
+        lines.append(f"SYNC MISMATCH: {m}")
     for m in res.status_mismatches:
         lines.append(f"STATUS MISMATCH: {m}")
     if res.unmatched_receives:

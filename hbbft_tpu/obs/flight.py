@@ -281,9 +281,19 @@ class FlightRecorder:
         self._c_rotations = r.counter(
             "hbbft_obs_flight_rotations_total",
             "segment rotations (size cap reached)")
+        self._c_truncations = r.counter(
+            "hbbft_obs_flight_truncations_total",
+            "journal segments deleted at digest-chain checkpoints "
+            "(bounded storage; the chain head covers the history)")
         self._g_segments = r.gauge(
             "hbbft_obs_flight_segments",
             "journal segment files currently retained on disk")
+        # highest commit-chain index each segment of THIS incarnation
+        # holds (checkpoint truncation can only reason about segments it
+        # watched being written; older incarnations' segments age out via
+        # the max_segments cap)
+        self._seg_commit_high: Dict[str, int] = {}
+        self._cur_commit_high = -1
         os.makedirs(dirpath, exist_ok=True)
         self.incarnation = self._next_incarnation()
         self._open_segment()
@@ -312,6 +322,8 @@ class FlightRecorder:
 
     def _open_segment(self) -> None:
         name = f"seg-{self.incarnation:04d}-{self._seg_idx:06d}.fjl"
+        self._seg_name = name
+        self._cur_commit_high = -1
         try:
             self._fh = open(os.path.join(self.dirpath, name), "wb")
         except OSError as exc:
@@ -332,6 +344,8 @@ class FlightRecorder:
                 self._fh.close()
             except OSError:
                 self._c_write_fail.inc()
+        if self._cur_commit_high >= 0:
+            self._seg_commit_high[self._seg_name] = self._cur_commit_high
         self._seg_idx += 1
         self._c_rotations.inc()
         segs = self._segments()
@@ -341,6 +355,9 @@ class FlightRecorder:
                 os.remove(os.path.join(self.dirpath, name))
             except OSError:
                 self._c_write_fail.inc()
+            # keep the checkpoint map in step with the disk, or
+            # truncate_checkpoint would retry the missing file forever
+            self._seg_commit_high.pop(name, None)
         self._open_segment()
 
     def close(self) -> None:
@@ -412,7 +429,40 @@ class FlightRecorder:
                       digest: bytes) -> None:
         self._append(FlightCommit(self._next_seq(), self._now(), era,
                                   epoch, index, digest))
+        if index > self._cur_commit_high:
+            self._cur_commit_high = index
         self.flush()  # a commit is the record worth surviving a crash
+
+    def truncate_checkpoint(self, min_index: int) -> int:
+        """Bounded storage: delete rotated segments of this incarnation
+        whose every commit lies below digest-chain index ``min_index`` —
+        the checkpointed chain (head + ``/status``) covers them.  The
+        current segment is never deleted.  Returns how many segments
+        were removed (each counted)."""
+        if min_index <= 0:
+            return 0
+        removed = 0
+        for name in sorted(self._seg_commit_high):
+            if self._seg_commit_high[name] >= min_index:
+                continue
+            try:
+                os.remove(os.path.join(self.dirpath, name))
+            # hblint: disable=fault-swallowed-drop (nothing dropped: the
+            # segment is already gone — the max_segments cap beat this
+            # checkpoint to it; counting it as a write failure would
+            # fake a disk-health signal)
+            except FileNotFoundError:
+                del self._seg_commit_high[name]
+                continue
+            except OSError:
+                self._c_write_fail.inc()
+                continue
+            del self._seg_commit_high[name]
+            removed += 1
+            self._c_truncations.inc()
+        if removed:
+            self._g_segments.set(len(self._segments()))
+        return removed
 
     def record_fault(self, node: str, kind: str, era: int = 0,
                      epoch: int = UNKNOWN_EPOCH) -> None:
@@ -440,6 +490,7 @@ class FlightRecorder:
             "records": int(self._c_records.total()),
             "bytes": int(self._c_bytes.value()),
             "segments": len(self._segments()),
+            "truncations": int(self._c_truncations.value()),
             "write_failures": int(self._c_write_fail.value()),
         }
 
@@ -474,6 +525,14 @@ class FlightObserver(StepObserver):
         self._ledger = b"\x00" * 32
         self._chain_len = 0
         self._last_key = (0, UNKNOWN_EPOCH)
+
+    def seed_chain(self, head: bytes, chain_len: int) -> None:
+        """Snapshot state-sync activation: continue the digest chain
+        from an era boundary instead of genesis, so this journal's
+        commit indices line up with the donors' (the auditor verifies
+        the boundary against the accompanying ``statesync`` note)."""
+        self._ledger = bytes(head)
+        self._chain_len = int(chain_len)
 
     # -- StepObserver --------------------------------------------------------
 

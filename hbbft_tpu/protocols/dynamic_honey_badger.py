@@ -450,6 +450,14 @@ class DynamicHoneyBadger(ConsensusProtocol):
         self.key_gen_change: Optional[Change] = None
         self.pending_kg: List[SignedKeyGenMsg] = []
         self.kg_seen: Set[bytes] = set()
+        # the consensus-committed DKG transcript of the in-progress change
+        # (every signature-valid key-gen message, in committed order) and,
+        # after a node-change rotation, the completed era's transcript —
+        # what a snapshot-joining node replays through its own SyncKeyGen
+        # to decrypt its rows and derive its secret key share with zero
+        # epoch replay (see hbbft_tpu.snapshot)
+        self.kg_transcript: List[SignedKeyGenMsg] = []
+        self.last_join_transcript: Tuple[SignedKeyGenMsg, ...] = ()
         self.vote_num = 0
         self.future_era: List[Tuple[NodeId, object]] = []
         # what to propose when only the DKG needs the epoch to advance: a
@@ -475,13 +483,20 @@ class DynamicHoneyBadger(ConsensusProtocol):
         secret_key: tc.SecretKey,
         plan: JoinPlan,
         rng: Optional[random.Random] = None,
+        secret_key_share: Optional[tc.SecretKeyShare] = None,
     ) -> "DynamicHoneyBadger":
-        """Construct a (non-validator) node starting at an era boundary."""
+        """Construct a node starting at an era boundary.
+
+        Without ``secret_key_share`` the node is an observer (the
+        reference's JoinPlan semantics); with one — derived by replaying
+        the era's committed DKG transcript through ``SyncKeyGen`` (see
+        :func:`hbbft_tpu.snapshot.derive_secret_share`) — it is a full
+        validator from epoch 0 of the plan's era."""
         netinfo = NetworkInfo(
             our_id=our_id,
             public_keys=plan.key_map(),
             public_key_set=plan.public_key_set(),
-            secret_key_share=None,
+            secret_key_share=secret_key_share,
             secret_key=secret_key,
         )
         k, a, b = plan.encryption_schedule
@@ -752,6 +767,7 @@ class DynamicHoneyBadger(ConsensusProtocol):
             return step
         # start the DKG among the new validator set
         self.key_gen_change = change
+        self.kg_transcript = []
         new_keys = change.key_map()
         threshold = (len(new_keys) - 1) // 3
         self.key_gen = SyncKeyGen(
@@ -776,6 +792,11 @@ class DynamicHoneyBadger(ConsensusProtocol):
         pk = self._kg_key_map().get(skg.sender)
         if pk is None or not pk.verify(skg.sig, skg.signed_payload()):
             return Step.from_fault(proposer, FaultKind.InvalidKeyGenMessage)
+        # transcript entry: every signature-valid committed message, in
+        # committed order — a snapshot joiner replaying these through its
+        # own SyncKeyGen reaches the identical complete-dealer set (the
+        # messages below that SyncKeyGen rejects, it rejects identically)
+        self.kg_transcript.append(skg)
         step = Step()
         try:
             if skg.kind == "part":
@@ -831,6 +852,15 @@ class DynamicHoneyBadger(ConsensusProtocol):
         self.era_has_batches = False
         self.change_state = ChangeState.none()
         self.vote_counter = VoteCounter(self.era)
+        # a node-change era carries its DKG transcript to the boundary:
+        # join_plan() + last_join_transcript is the complete snapshot a
+        # joiner needs (an encryption-schedule rotation keeps the old key
+        # material, so its transcript is empty and joiners fall back to
+        # config-derived shares — see snapshot.derive_secret_share)
+        self.last_join_transcript = (
+            tuple(self.kg_transcript) if change.kind == "nodes" else ()
+        )
+        self.kg_transcript = []
         self.key_gen = None
         self.key_gen_change = None
         self.pending_kg = []

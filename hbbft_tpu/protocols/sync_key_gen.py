@@ -120,8 +120,9 @@ class SyncKeyGen:
         bp = tc.BivarPoly.random(self.threshold, self.rng)
         commitment = _batch.bivar_commitment(bp)
         rows = []
-        for j in range(n):
-            row = bp.row(j + 1)
+        # all n rows in one finite-difference sweep (consecutive share
+        # points — the efficient-Shamir evaluation from PAPERS.md)
+        for j, row in enumerate(_batch.bivar_rows_range(bp, n)):
             ct = self.pub_keys[self.ids[j]].encrypt(_ser_poly(row), self.rng)
             rows.append(ct)
         return Part(commitment, tuple(rows))
@@ -160,8 +161,10 @@ class SyncKeyGen:
         self._row_polys[dealer] = row
         self.our_rows[dealer] = row.evaluate(0)
         values = []
-        for j in range(len(self.ids)):
-            v = row.evaluate(j + 1)
+        # one finite-difference sweep over all node indices (PAPERS.md's
+        # efficient Shamir share evaluation) instead of n Horner passes
+        for j, v in enumerate(_batch.poly_eval_range(row.coeffs,
+                                                     len(self.ids))):
             ct = self.pub_keys[self.ids[j]].encrypt(
                 v.to_bytes(32, "big"), self.rng
             )

@@ -494,6 +494,36 @@ def _lazy_register():
     _register(0x85, FlightNote,
               lambda m: u64(m.seq) + f64(m.t) + s(m.kind) + s(m.detail),
               lambda r: FlightNote(r.u64(), r.f64(), rs(r), rs(r)))
+    # snapshot state-sync records (net/statesync.py) --------------------------
+    # Carried in framing.SYNC frames on client-role connections; registered
+    # here so the wire-completeness checker and test_wire's per-type
+    # hash/round-trip regression cover the transfer format.
+    from hbbft_tpu.net.statesync import (
+        SyncChunk, SyncChunkReq, SyncManifest, SyncManifestReq, SyncNack,
+    )
+
+    def rd32(r: Reader) -> bytes:
+        return r.take(32)
+
+    _register(0x90, SyncManifestReq,
+              lambda m: b"",
+              lambda r: SyncManifestReq())
+    _register(0x91, SyncManifest,
+              lambda m: (u64(m.era) + u64(m.chain_len) + m.chain_head
+                         + m.image_sha3 + u64(m.image_len)
+                         + u32(m.chunk_bytes) + u32(m.n_chunks)),
+              lambda r: SyncManifest(r.u64(), r.u64(), rd32(r), rd32(r),
+                                     r.u64(), r.u32(), r.u32()))
+    _register(0x92, SyncChunkReq,
+              lambda m: m.image_sha3 + u32(m.index),
+              lambda r: SyncChunkReq(rd32(r), r.u32()))
+    _register(0x93, SyncChunk,
+              lambda m: (m.image_sha3 + u32(m.index) + u32(m.crc)
+                         + blob(m.data)),
+              lambda r: SyncChunk(rd32(r), r.u32(), r.u32(), r.blob()))
+    _register(0x94, SyncNack,
+              lambda m: s(m.reason),
+              lambda r: SyncNack(rs(r)))
 
 
 def ensure_registered():

@@ -371,6 +371,89 @@ class EclipseAdversary(Adversary):
             self._held.clear()
 
 
+class VoteStormAdversary(Adversary):
+    """Membership-vote storms: drives DynamicHoneyBadger era rotations
+    (and vote chaos) WHILE the link layer is doing its worst.
+
+    On a seeded schedule — a crank threshold, or the moment the network
+    goes quiescent, whichever comes first — every correct validator is
+    fed a :class:`~hbbft_tpu.protocols.dynamic_honey_badger.ChangeInput`:
+
+    - **coordinated waves** alternate removing and re-adding a victim
+      validator, each winning vote starting a REAL SyncKeyGen DKG and
+      rotating the era — composed with ``partition-10s`` link shaping
+      this is a DKG rotation riding out a partition, ROADMAP item 4's
+      named next step;
+    - **split waves** (seeded coin) hand half the validators a remove
+      vote and half a keep vote: no majority, no rotation, just vote
+      traffic piggy-backing on every contribution until a later
+      coordinated wave supersedes it (``VoteCounter``'s later-vote-wins
+      pressure).
+
+    Deterministic per seed: the schedule depends only on crank counts,
+    quiescence, and the seeded RNG.  Injection counts are exposed
+    (``waves``, ``injected``) and rotations are visible to the auditor
+    as era changes in the committed batches — a clean cell must commit
+    across every boundary with all chains agreeing.
+    """
+
+    def __init__(self, seed: int = 0, first_crank: int = 300,
+                 min_gap: int = 600, max_waves: int = 4, victim=None):
+        self.rng = random.Random(seed)
+        self.min_gap = min_gap
+        self.max_waves = max_waves
+        self.victim = victim
+        self.waves = 0
+        self.injected = 0
+        self._next_at = first_crank
+        self._removed = False
+        self._victim_pk = None
+
+    def pre_crank(self, net: "VirtualNet") -> None:
+        if self.waves >= self.max_waves:
+            return
+        if net.cranks < self._next_at and not (net.quiescent
+                                               and net.cranks > 0):
+            return
+        from hbbft_tpu.protocols.dynamic_honey_badger import (
+            Change, ChangeInput,
+        )
+
+        correct = net.correct_ids()
+        probe = net.nodes[correct[0]].algorithm
+        dhb = getattr(probe, "dhb", probe)
+        if dhb.change_state.state != "none":
+            # a DKG is already in flight — let it rotate before storming
+            # again (retry shortly; quiescence keeps the run alive)
+            self._next_at = net.cranks + 200
+            return
+        keys = dict(dhb.netinfo.public_key_map())
+        victim = self.victim if self.victim is not None else correct[-1]
+        self.waves += 1
+        self._next_at = net.cranks + self.min_gap
+        split = self.rng.random() < 0.34
+        if not self._removed:
+            if victim not in keys:
+                return  # victim vanished from the key map: nothing to do
+            self._victim_pk = keys[victim]
+            target = {k: v for k, v in keys.items() if k != victim}
+        else:
+            target = dict(keys)
+            target[victim] = self._victim_pk
+        change = Change.node_change(target)
+        if split:
+            keep = Change.node_change(keys)
+            for i, nid in enumerate(correct):
+                net.send_input(
+                    nid, ChangeInput(change if i % 2 == 0 else keep))
+                self.injected += 1
+            return
+        self._removed = not self._removed
+        for nid in correct:
+            net.send_input(nid, ChangeInput(change))
+            self.injected += 1
+
+
 class CrashAtEpochAdversary(Adversary):
     """Crash-stop at epoch: once the victim node has produced
     ``after_batches`` outputs (committed batches for a QHB stack), ALL

@@ -115,6 +115,36 @@ def test_churn_cell_restarts_and_audits_clean(tmp_path):
     assert detail["common_prefix_len"] >= 1
 
 
+def test_vote_storm_rotates_eras_under_partition(tmp_path):
+    """ROADMAP item 4's named next step: a vote-storm cell under the
+    timed-partition preset drives REAL remove/re-add DKG rotations
+    mid-partition — every chain crosses the era boundaries and the
+    era-aware auditor returns clean."""
+    spec = CellSpec(shape="partition-10s", adversary="vote-storm", n=4,
+                    seed=0, time_scale=SIM_SCALES["partition-10s"],
+                    crank_limit=60_000)
+    detail, res = run_cell(spec, str(tmp_path))
+    assert detail["verdict"] == "clean", res.as_dict()
+    assert detail["eras_rotated"] >= 1, \
+        "the storm never won a vote — no DKG rotation happened"
+    assert detail["batches_min"] >= 1
+    # the partition actually held traffic while eras rotated
+    assert detail["shaping"]["partition_holds"] > 0
+
+
+def test_socket_cell_pipelined_wan(tmp_path):
+    """Satellite: a WAN-shaped REAL socket cluster at pipeline_depth=2
+    commits under chaos and audits clean (the campaign's socket kind)."""
+    from hbbft_tpu.chaos.campaign import run_socket_cell
+
+    detail, _res = run_socket_cell(
+        CellSpec(kind="socket", shape="wan-100ms", adversary="null",
+                 n=4, seed=0, pipeline_depth=2), str(tmp_path))
+    assert detail["verdict"] == "clean"
+    assert detail["batches_min"] >= 1
+    assert detail["pipeline_depth"] == 2
+
+
 def test_campaign_cli_smoke(tmp_path):
     out = tmp_path / "report.json"
     rc = campaign_main(["--grid", "smoke", "--max-cells", "2",

@@ -1,0 +1,656 @@
+"""Membership lifecycle: snapshot state-sync join, bounded storage.
+
+Covers the PR-8 tentpole end to end:
+
+- ``JoinSnapshot`` image codec + DKG-transcript share derivation (a
+  joiner that never saw the DKG decrypts its rows and regenerates the
+  exact public key set — or refuses a tampered transcript loudly);
+- the chunked transfer protocol: manifests, CRC'd chunks, NACKs, donor
+  failover with resume (a donor killed mid-transfer costs a retry, not a
+  restart) and multi-donor manifest confirmation;
+- the live 4-node socket cluster join: DHB vote → DKG rotation →
+  state-sync → activation — identical post-join ledgers, a clean
+  forensic audit across the era boundary (with a concurrent
+  crash-restart), and commits within 10 epochs of activation;
+- restart-beyond-retention recovery through the same path;
+- bounded storage: replay-log byte caps and flight-journal checkpoint
+  truncation keep disk/memory under the configured ceilings, counted
+  and visible in ``/status``.
+"""
+
+import asyncio
+import hashlib
+import os
+import random
+import zlib
+
+import pytest
+
+from hbbft_tpu.crypto import tc
+from hbbft_tpu.net import framing
+from hbbft_tpu.net.statesync import (
+    SnapshotStore,
+    StateSyncClient,
+    StateSyncError,
+    SyncChunk,
+    SyncChunkReq,
+    SyncManifest,
+    SyncManifestReq,
+    SyncNack,
+)
+from hbbft_tpu.obs.metrics import Registry
+from hbbft_tpu.protocols.dynamic_honey_badger import (
+    SignedKeyGenMsg,
+    _keygen_payload,
+    ser_ack,
+    ser_part,
+)
+from hbbft_tpu.protocols.sync_key_gen import SyncKeyGen
+from hbbft_tpu.snapshot import (
+    JoinSnapshot,
+    decode_join_snapshot,
+    derive_secret_share,
+    encode_join_snapshot,
+)
+
+CLUSTER_ID = b"statesync-test"
+
+
+# ===========================================================================
+# Unit: image codec + share derivation
+# ===========================================================================
+
+
+def _manual_dkg(n_old: int = 4, joiner_id: int = 9):
+    """A committed DKG transcript among ``n_old`` present validators plus
+    one absent candidate, exactly as DHB would commit it: every Part and
+    every Ack signed by its sender, in deterministic order."""
+    rng = random.Random(42)
+    ids = list(range(n_old)) + [joiner_id]
+    sks = {i: tc.SecretKey.random(rng) for i in ids}
+    pub = {i: sks[i].public_key() for i in ids}
+    threshold = (len(ids) - 1) // 3
+    kgs = {
+        i: SyncKeyGen(i, sks[i], pub, threshold, random.Random(100 + i))
+        for i in range(n_old)
+    }
+    era = 0
+
+    def signed(sender: int, kind: str, payload: bytes) -> SignedKeyGenMsg:
+        return SignedKeyGenMsg(
+            era, sender, kind, payload,
+            sks[sender].sign(_keygen_payload(era, sender, kind, payload)),
+        )
+
+    transcript = []
+    for dealer in range(n_old):
+        part = kgs[dealer].generate_part()
+        transcript.append(signed(dealer, "part", ser_part(part)))
+        acks = []
+        for i in range(n_old):
+            outcome = kgs[i].handle_part(dealer, part)
+            assert outcome.fault is None
+            if outcome.ack is not None:
+                acks.append((i, outcome.ack))
+        for i, ack in acks:
+            transcript.append(signed(i, "ack", ser_ack(ack)))
+            for j in range(n_old):
+                assert kgs[j].handle_ack(i, ack).fault is None
+    assert all(kg.is_ready() for kg in kgs.values())
+    pks0, share0 = kgs[0].generate()
+    snap = JoinSnapshot(
+        era=era + 1,
+        pub_key_set_bytes=pks0.commitment.to_bytes(),
+        pub_keys=tuple(sorted(
+            ((i, pk.to_bytes()) for i, pk in pub.items()),
+            key=lambda kv: repr(kv[0]))),
+        encryption_schedule=("never", 0, 0),
+        transcript=tuple(transcript),
+        chain_head=hashlib.sha3_256(b"boundary").digest(),
+        chain_len=7,
+    )
+    return snap, sks, pks0, share0, ids
+
+
+def test_join_snapshot_roundtrip():
+    snap, _sks, _pks, _share, _ids = _manual_dkg()
+    image = encode_join_snapshot(snap)
+    back = decode_join_snapshot(image)
+    assert back == snap
+    with pytest.raises(ValueError):
+        decode_join_snapshot(image[:-1])
+    with pytest.raises(ValueError):
+        decode_join_snapshot(b"XX" + image)
+
+
+def test_share_derivation_from_transcript():
+    """The absent candidate replays the committed transcript, decrypts
+    its rows, and signs with a share that COMBINES with a validator's —
+    the cryptographic proof it joined the same key set."""
+    snap, sks, pks, share0, ids = _manual_dkg()
+    joiner = ids[-1]
+    share_j = derive_secret_share(snap, joiner, sks[joiner])
+    assert share_j is not None
+    msg = b"joined-era-1"
+    # joiner is the last index in the sorted id order
+    j_index = sorted(ids).index(joiner)
+    sigs = {0: share0.sign(msg), j_index: share_j.sign(msg)}
+    combined = pks.combine_signatures(sigs)
+    assert pks.public_key().verify(combined, msg)
+
+
+def test_share_derivation_rejects_tampering():
+    snap, sks, _pks, _share, ids = _manual_dkg()
+    joiner = ids[-1]
+    # a donor claiming a different public key set than the transcript
+    # produces must be refused
+    bad = JoinSnapshot(
+        era=snap.era,
+        pub_key_set_bytes=b"\x00" * len(snap.pub_key_set_bytes),
+        pub_keys=snap.pub_keys,
+        encryption_schedule=snap.encryption_schedule,
+        transcript=snap.transcript,
+        chain_head=snap.chain_head,
+        chain_len=snap.chain_len,
+    )
+    with pytest.raises(ValueError, match="different public key set"):
+        derive_secret_share(bad, joiner, sks[joiner])
+    # a truncated transcript (DKG cannot complete) is refused too
+    stub = JoinSnapshot(
+        era=snap.era,
+        pub_key_set_bytes=snap.pub_key_set_bytes,
+        pub_keys=snap.pub_keys,
+        encryption_schedule=snap.encryption_schedule,
+        transcript=snap.transcript[:1],
+        chain_head=snap.chain_head,
+        chain_len=snap.chain_len,
+    )
+    with pytest.raises(ValueError, match="does not complete"):
+        derive_secret_share(stub, joiner, sks[joiner])
+
+
+def test_snapshot_store_serving():
+    snap, _sks, _pks, _share, _ids = _manual_dkg()
+    store = SnapshotStore(Registry(), chunk_bytes=1024)
+    assert isinstance(store.handle(SyncManifestReq()), SyncNack)
+    store.publish(snap)
+    m = store.handle(SyncManifestReq())
+    assert isinstance(m, SyncManifest)
+    assert m.era == snap.era and m.chain_len == snap.chain_len
+    chunks = []
+    for i in range(m.n_chunks):
+        ck = store.handle(SyncChunkReq(m.image_sha3, i))
+        assert isinstance(ck, SyncChunk) and ck.index == i
+        assert zlib.crc32(ck.data) == ck.crc
+        chunks.append(ck.data)
+    image = b"".join(chunks)
+    assert len(image) == m.image_len
+    assert hashlib.sha3_256(image).digest() == m.image_sha3
+    assert decode_join_snapshot(image) == snap
+    # nacks: wrong image, bad index
+    assert isinstance(store.handle(SyncChunkReq(b"\x00" * 32, 0)),
+                      SyncNack)
+    assert isinstance(store.handle(SyncChunkReq(m.image_sha3,
+                                                m.n_chunks)), SyncNack)
+
+
+# ===========================================================================
+# Transfer: failover + resume against scripted donors
+# ===========================================================================
+
+
+class _FakeDonor:
+    """A minimal donor speaking HELLO + SYNC, optionally dying after
+    serving ``die_after_chunks`` chunks (socket closed mid-transfer)."""
+
+    def __init__(self, store: SnapshotStore, die_after_chunks=None):
+        self.store = store
+        self.die_after_chunks = die_after_chunks
+        self.chunks_served = 0
+        self.server = None
+        self.addr = None
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._serve,
+                                                 host="127.0.0.1", port=0)
+        self.addr = self.server.sockets[0].getsockname()[:2]
+        return self.addr
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+
+    async def _serve(self, reader, writer):
+        from hbbft_tpu.protocols import wire
+
+        try:
+            kind, payload = await framing.read_one_frame(reader)
+            assert kind == framing.HELLO
+            hello = framing.decode_hello(payload)
+            reply = framing.Hello(node_id=0, role=framing.ROLE_NODE,
+                                  cluster_id=hello.cluster_id,
+                                  era=0, epoch=0)
+            writer.write(framing.encode_frame(
+                framing.HELLO, framing.encode_hello(reply)))
+            await writer.drain()
+            while True:
+                kind, payload = await framing.read_one_frame(reader)
+                if kind != framing.SYNC:
+                    continue
+                msg = wire.decode_message(payload)
+                if isinstance(msg, SyncChunkReq):
+                    if (self.die_after_chunks is not None
+                            and self.chunks_served
+                            >= self.die_after_chunks):
+                        writer.close()
+                        return
+                    self.chunks_served += 1
+                writer.write(framing.encode_frame(
+                    framing.SYNC,
+                    wire.encode_message(self.store.handle(msg))))
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            return
+
+
+def test_transfer_failover_resumes_on_second_donor():
+    snap, _sks, _pks, _share, _ids = _manual_dkg()
+    store = SnapshotStore(Registry(), chunk_bytes=2048)
+    store.publish(snap)
+    assert store.manifest.n_chunks >= 3, "image too small for the test"
+
+    async def run():
+        flaky = _FakeDonor(store, die_after_chunks=1)
+        solid = _FakeDonor(store)
+        a1 = await flaky.start()
+        a2 = await solid.start()
+        reg = Registry()
+        client = StateSyncClient(
+            [a1, a2], CLUSTER_ID, request_timeout_s=1.0,
+            connect_timeout_s=1.0, min_manifest_confirm=2,
+            backoff_base_s=0.05, registry=reg,
+        )
+        got = await client.fetch()
+        await flaky.stop()
+        await solid.stop()
+        return got, reg, flaky, solid
+
+    got, reg, flaky, solid = asyncio.run(run())
+    assert got == snap
+    text = reg.render_prometheus()
+    assert "hbbft_sync_donor_failovers_total" in text
+    # the flaky donor died mid-transfer; the solid one finished the image
+    assert flaky.chunks_served == 1
+    assert solid.chunks_served >= store.manifest.n_chunks - 1
+
+
+def test_transfer_abandons_loudly_when_all_donors_die():
+    snap, _sks, _pks, _share, _ids = _manual_dkg()
+    store = SnapshotStore(Registry(), chunk_bytes=2048)
+    store.publish(snap)
+
+    async def run():
+        d = _FakeDonor(store, die_after_chunks=0)
+        addr = await d.start()
+        reg = Registry()
+        client = StateSyncClient(
+            [addr], CLUSTER_ID, request_timeout_s=0.5,
+            connect_timeout_s=0.5, max_donor_cycles=2,
+            backoff_base_s=0.01, registry=reg,
+        )
+        with pytest.raises(StateSyncError, match="abandoned"):
+            await client.fetch()
+        await d.stop()
+        return reg
+
+    reg = asyncio.run(run())
+    assert reg.get("hbbft_sync_transfers_abandoned_total").value() >= 1
+
+
+def test_transfer_restarts_when_snapshot_rotates_mid_fetch():
+    """Donors that rotate to a NEWER snapshot mid-transfer (old image →
+    'unknown image' NACKs everywhere) make the client refresh manifests
+    and restart on the new image instead of abandoning."""
+    snap_old, _s, _p, _sh, _ids = _manual_dkg()
+    snap_new = JoinSnapshot(
+        era=snap_old.era + 1,
+        pub_key_set_bytes=snap_old.pub_key_set_bytes,
+        pub_keys=snap_old.pub_keys,
+        encryption_schedule=snap_old.encryption_schedule,
+        transcript=(),
+        chain_head=hashlib.sha3_256(b"newer boundary").digest(),
+        chain_len=snap_old.chain_len + 5,
+    )
+    store = SnapshotStore(Registry(), chunk_bytes=2048)
+    store.publish(snap_old)
+    old_sha = store.manifest.image_sha3
+
+    class _RotatingDonor(_FakeDonor):
+        """Publishes the newer snapshot after serving one chunk."""
+
+        async def _serve(self, reader, writer):
+            self._orig_handle = self.store.handle
+
+            def handle(msg):
+                if (isinstance(msg, SyncChunkReq)
+                        and msg.image_sha3 == old_sha
+                        and self.chunks_served >= 1):
+                    self.store.publish(snap_new)
+                return self._orig_handle(msg)
+
+            self.store.handle = handle
+            try:
+                await super()._serve(reader, writer)
+            finally:
+                self.store.handle = self._orig_handle
+
+    async def run():
+        d = _RotatingDonor(store)
+        addr = await d.start()
+        reg = Registry()
+        client = StateSyncClient(
+            [addr], CLUSTER_ID, request_timeout_s=1.0,
+            connect_timeout_s=1.0, backoff_base_s=0.01,
+            max_donor_cycles=2, registry=reg,
+        )
+        got = await client.fetch()
+        await d.stop()
+        return got, reg
+
+    got, reg = asyncio.run(run())
+    assert got == snap_new, "client should land on the NEW snapshot"
+    assert reg.get("hbbft_sync_transfers_abandoned_total").value() == 0
+
+
+def test_manifest_quorum_required():
+    """One donor alone cannot satisfy min_manifest_confirm=2."""
+    snap, _sks, _pks, _share, _ids = _manual_dkg()
+    store = SnapshotStore(Registry(), chunk_bytes=2048)
+    store.publish(snap)
+
+    async def run():
+        d = _FakeDonor(store)
+        addr = await d.start()
+        client = StateSyncClient([addr], CLUSTER_ID,
+                                 request_timeout_s=1.0,
+                                 min_manifest_confirm=2)
+        with pytest.raises(StateSyncError, match="agree"):
+            await client.fetch()
+        await d.stop()
+
+    asyncio.run(run())
+
+
+# ===========================================================================
+# Live cluster: the membership lifecycle end-to-end
+# ===========================================================================
+
+
+async def _pump_wave(cluster, client, wave: int, count: int):
+    txs = [b"join-%02d-%04d" % (wave, i) for i in range(count)]
+    for tx in txs:
+        status = await client.submit(tx)
+        assert status == 0, f"tx rejected with {status}"
+    for tx in txs:
+        await client.wait_committed(tx, timeout_s=60)
+
+
+def test_join_from_snapshot_live_cluster(tmp_path):
+    """The acceptance incident: a validator with NO history joins a live
+    committing 4-node socket cluster via DHB vote + DKG rotation +
+    snapshot state-sync, with one concurrent crash-restart — identical
+    ledgers, commits within 10 epochs of activation, clean audit across
+    the era boundary (state-sync boundary corroborated)."""
+    from hbbft_tpu.net.cluster import (
+        ClusterConfig,
+        LocalCluster,
+        find_free_base_port,
+    )
+    from hbbft_tpu.obs.audit import run_audit
+
+    flight_root = str(tmp_path / "flight")
+    cfg = ClusterConfig(
+        n=4, seed=3, batch_size=4,
+        base_port=find_free_base_port(6),
+        heartbeat_s=0.2, dead_after_s=1.5,
+        flight_dir=flight_root,
+    )
+
+    async def scenario():
+        cluster = LocalCluster(cfg)
+        await cluster.start()
+        try:
+            client = await cluster.client(0)
+            await _pump_wave(cluster, client, 0, cfg.batch_size * 2)
+            # the join vote: every validator votes node 4 in; the DKG
+            # rotation's boundary snapshot becomes fetchable everywhere
+            cluster.vote_to_add(4)
+            await cluster.wait_snapshot(min_era=1, timeout_s=60)
+            # concurrent crash-restart while the join is in flight
+            await cluster.restart_node(1)
+            joiner = await cluster.activate_from_snapshot(
+                4, donors=[0, 2, 3], min_manifest_confirm=2)
+            activation_key = joiner.current_key()
+            # traffic keeps flowing; the joiner must commit
+            await _pump_wave(cluster, client, 1, cfg.batch_size * 2)
+
+            async def joiner_commits():
+                while not joiner.batches:
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(joiner_commits(), 60)
+            first = joiner.batches[0]
+            assert first.era >= activation_key[0]
+            assert (first.era, first.epoch) <= (
+                activation_key[0], activation_key[1] + 10
+            ), "joiner's first commit is not within 10 epochs"
+            # every node, joiner included, agrees wherever chains
+            # overlap — wait until even the restarted node's rebuilt
+            # chain reaches past the joiner's boundary
+            boundary = joiner.digest_chain_offset
+
+            async def chains_overlap():
+                while min(rt.chain_len for rt in cluster.runtimes) \
+                        <= boundary:
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(chains_overlap(), 60)
+            prefix = cluster.common_digest_prefix()
+            assert prefix, "joiner's chain never overlapped the donors'"
+            assert joiner.digest_chain_offset >= 1, \
+                "joiner should start mid-chain (snapshot boundary)"
+            assert joiner.sq.algo.dhb.is_validator(), \
+                "transcript replay should make the joiner a validator"
+            assert joiner.sq.algo.dhb.netinfo.secret_key_share() \
+                is not None
+            docs = [rt.status_doc() for rt in cluster.runtimes]
+            from hbbft_tpu.net.cluster import (
+                assert_status_chains_consistent,
+            )
+
+            assert assert_status_chains_consistent(docs) > 0
+        finally:
+            await cluster.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 180))
+    res, _journals = run_audit([flight_root])
+    assert res.verdict == "clean", res.as_dict()
+    # the audit saw the era boundary AND the state-sync join, and
+    # corroborated the joiner's claimed boundary against a donor journal
+    assert res.restarts.get("1", 0) == 1
+    joins = [j for j in res.sync_joins if j["node"] == "4"]
+    assert joins and joins[0]["verified_against"] is not None
+    assert not res.sync_mismatches
+
+
+def test_resync_after_retention_gap(tmp_path):
+    """A validator whose outage outlived replay retention recovers via
+    the SAME snapshot path (checkpoint rotation → state-sync), instead
+    of wedging on replay_gaps."""
+    from hbbft_tpu.net.cluster import (
+        ClusterConfig,
+        LocalCluster,
+        find_free_base_port,
+    )
+    from hbbft_tpu.obs.audit import run_audit
+
+    flight_root = str(tmp_path / "flight")
+    cfg = ClusterConfig(
+        n=4, seed=11, batch_size=4,
+        base_port=find_free_base_port(5),
+        heartbeat_s=0.2, dead_after_s=1.5,
+        replay_retain_epochs=4,          # tiny: outages outlive it fast
+        flight_dir=flight_root,
+    )
+
+    async def scenario():
+        cluster = LocalCluster(cfg)
+        await cluster.start()
+        try:
+            client = await cluster.client(0)
+            await _pump_wave(cluster, client, 0, cfg.batch_size)
+            # node 3 goes dark; the cluster outruns its replay retention
+            await cluster.runtimes[3].stop()
+            for wave in range(1, 4):
+                await _pump_wave(cluster, client, wave,
+                                 cfg.batch_size * 2)
+            survivors = cluster.runtimes[:3]
+            assert min(len(rt.batches) for rt in survivors) > \
+                cfg.replay_retain_epochs
+            # checkpoint rotation: a node-change vote to the CURRENT key
+            # map runs a fresh DKG and rotates the era, re-arming
+            # snapshot joins with a transcript node 3 can derive its new
+            # share from
+            cluster.runtimes = survivors
+            cluster.vote_to_readd()
+            await cluster.wait_snapshot(min_era=1, timeout_s=60)
+            rejoined = await cluster.activate_from_snapshot(
+                3, donors=[0, 1, 2], min_manifest_confirm=2)
+            await _pump_wave(cluster, client, 9, cfg.batch_size * 2)
+
+            async def caught_up():
+                while not rejoined.batches:
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(caught_up(), 60)
+            assert rejoined.sq.algo.dhb.is_validator()
+            assert rejoined.sq.algo.dhb.netinfo.secret_key_share() \
+                is not None
+            assert cluster.common_digest_prefix() is not None
+            # the recovery replayed ZERO pre-boundary history
+            assert rejoined.digest_chain_offset > 0
+        finally:
+            await cluster.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 180))
+    res, _journals = run_audit([flight_root])
+    assert res.verdict == "clean", res.as_dict()
+    joins = [j for j in res.sync_joins if j["node"] == "3"]
+    assert joins and not res.sync_mismatches
+
+
+def test_bounded_storage_regression(tmp_path):
+    """Replay logs and flight journals stay under their configured caps
+    over a long-ish run, with truncations counted and visible in
+    /status."""
+    from hbbft_tpu.net.cluster import (
+        ClusterConfig,
+        LocalCluster,
+        find_free_base_port,
+    )
+
+    flight_root = str(tmp_path / "flight")
+    seg_bytes = 64 * 1024
+    cfg = ClusterConfig(
+        n=4, seed=5, batch_size=4,
+        base_port=find_free_base_port(4),
+        heartbeat_s=0.2, dead_after_s=1.5,
+        replay_retain_epochs=256,        # epochs alone would not bound it
+        replay_retain_bytes=16 * 1024,   # the byte cap must
+        flight_dir=flight_root,
+        flight_max_segment_bytes=seg_bytes,
+        flight_max_segments=64,
+        flight_retain_batches=8,         # checkpoint truncation
+    )
+
+    async def scenario():
+        cluster = LocalCluster(cfg)
+        await cluster.start()
+        try:
+            client = await cluster.client(0)
+            for wave in range(6):
+                await _pump_wave(cluster, client, wave,
+                                 cfg.batch_size * 2)
+            docs = [rt.status_doc() for rt in cluster.runtimes]
+            for rt, doc in zip(cluster.runtimes, docs):
+                # replay log honors the per-peer byte cap (+1 entry of
+                # slack: the cap is enforced at the per-iteration prune)
+                for peer, used in rt._replay_bytes.items():
+                    assert used <= cfg.replay_retain_bytes + 4096, (
+                        peer, used)
+                assert "replay_truncations" in doc
+                assert "replay_log_bytes" in doc
+                assert doc["flight"]["truncations"] >= 0
+            total_trunc = sum(
+                doc["replay_truncations"] for doc in docs)
+            assert total_trunc > 0, \
+                "the byte cap never triggered — grow the run"
+            flight_trunc = sum(
+                doc["flight"]["truncations"] for doc in docs)
+            assert flight_trunc > 0, \
+                "checkpoint truncation never triggered"
+        finally:
+            await cluster.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 180))
+    # on-disk bound: segments per node ≤ cap, each ≤ segment bytes + one
+    # oversized record of slack
+    for node_dir in os.listdir(flight_root):
+        d = os.path.join(flight_root, node_dir)
+        segs = os.listdir(d)
+        assert len(segs) <= 64
+        total = sum(os.path.getsize(os.path.join(d, s)) for s in segs)
+        assert total <= 64 * (seg_bytes + 8192)
+
+
+# ===========================================================================
+# Audit: boundary verification
+# ===========================================================================
+
+
+def test_audit_flags_contradicted_sync_boundary(tmp_path):
+    """A joiner claiming a boundary digest nobody committed is a fork."""
+    from hbbft_tpu.obs.audit import audit
+    from hbbft_tpu.obs.flight import (
+        FlightObserver,
+        FlightRecorder,
+        read_journal,
+    )
+    from hbbft_tpu.traits import Step
+
+    honest_head = hashlib.sha3_256(b"honest").digest()
+    # donor journal: commits at indices 0 and 1
+    donor_dir = str(tmp_path / "donor")
+    rec = FlightRecorder(donor_dir, node="0", flavor="runtime")
+    rec.record_commit(0, 0, 0, honest_head)
+    rec.record_commit(0, 1, 1, hashlib.sha3_256(b"next").digest())
+    rec.close()
+    # joiner journal: claims it joined at index 1 with a DIFFERENT head
+    joiner_dir = str(tmp_path / "joiner")
+    rec2 = FlightRecorder(joiner_dir, node="9", flavor="runtime")
+    lying_head = hashlib.sha3_256(b"lies").digest()
+    rec2.note("statesync", f"index=1 head={lying_head.hex()}")
+    rec2.record_commit(1, 0, 1, hashlib.sha3_256(b"whatever").digest())
+    rec2.close()
+    res = audit([read_journal(donor_dir), read_journal(joiner_dir)])
+    assert res.sync_mismatches
+    assert res.verdict == "fork"
+    # and the honest version of the same claim is corroborated
+    joiner2 = str(tmp_path / "joiner2")
+    rec3 = FlightRecorder(joiner2, node="9", flavor="runtime")
+    rec3.note("statesync", f"index=1 head={honest_head.hex()}")
+    rec3.close()
+    res2 = audit([read_journal(donor_dir), read_journal(joiner2)])
+    assert not res2.sync_mismatches
+    assert [j for j in res2.sync_joins
+            if j["verified_against"] == "0"]

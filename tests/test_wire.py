@@ -208,11 +208,27 @@ def _flight_samples():
     ]
 
 
+def _sync_samples():
+    from hbbft_tpu.net.statesync import (
+        SyncChunk, SyncChunkReq, SyncManifest, SyncManifestReq, SyncNack,
+    )
+    import zlib
+
+    sha = b"\x5a" * 32
+    return [
+        SyncManifestReq(),
+        SyncManifest(2, 17, b"\xcd" * 32, sha, 70_001, 32_768, 3),
+        SyncChunkReq(sha, 1),
+        SyncChunk(sha, 1, zlib.crc32(b"chunk-bytes"), b"chunk-bytes"),
+        SyncNack("no snapshot published yet"),
+    ]
+
+
 def _sample_messages(crypto_bits):
     share, dshare, sig = crypto_bits
     tree = MerkleTree([b"shard-%d" % i for i in range(7)])
     skg = SignedKeyGenMsg(1, 3, "ack", b"\x00\x01\x02", sig)
-    return _flight_samples() + [
+    return _flight_samples() + _sync_samples() + [
         ValueMsg(tree.proof(3)),
         EchoMsg(tree.proof(0)),
         ReadyMsg(tree.root_hash()),
@@ -308,7 +324,7 @@ def test_every_registered_type_roundtrips_and_hashes(crypto_bits):
         EpochStarted((3, 11)),
         AlgoMessage(HbWrap(0, SubsetWrap(0, BroadcastWrap(
             0, EchoMsg(tree.proof(1)))))),
-    ] + _flight_samples()
+    ] + _flight_samples() + _sync_samples()
     wire.ensure_registered()
     sampled = {type(m) for m in samples}
     registered = set(wire._MSG_TAGS)
