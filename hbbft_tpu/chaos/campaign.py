@@ -57,6 +57,9 @@ from hbbft_tpu.sim.adversary import (
     CrashAtEpochAdversary,
     EclipseAdversary,
     EquivocatingAdversary,
+    FloodAdversary,
+    FutureEpochSpamAdversary,
+    GarbageStreamAdversary,
     MitmDelayAdversary,
     NullAdversary,
     ReorderingAdversary,
@@ -109,8 +112,11 @@ class CellSpec:
     @property
     def faulty(self) -> Tuple[int, ...]:
         """Byzantine node set implied by the adversary (the equivocator
-        needs a faulty sender for tamper() to apply to)."""
-        return (self.n - 1,) if self.adversary == "equivocate" else ()
+        needs a faulty sender for tamper() to apply to; the flood /
+        window-spam adversaries act under the last node's identity)."""
+        if self.adversary in ("equivocate", "flood", "future-spam"):
+            return (self.n - 1,)
+        return ()
 
     def as_dict(self) -> Dict[str, Any]:
         return asdict(self)
@@ -121,10 +127,14 @@ class CellSpec:
                       if k in doc})
 
 
-#: the adversary zoo, by campaign name
+#: the adversary zoo, by campaign name.  "flood" and "future-spam" are
+#: the overload-defense drills (valid-frame spam amplification and
+#: window-edge protocol spam); their socket siblings ("garbage-stream"
+#: and "flood" at kind "socket") drive a REAL cluster via raw-socket
+#: injectors instead of the simulator hooks.
 ADVERSARIES: Tuple[str, ...] = (
     "null", "reorder", "mitm-delay", "censor-ready", "eclipse", "crash",
-    "equivocate", "vote-storm",
+    "equivocate", "vote-storm", "flood", "future-spam",
 )
 
 #: per-preset sim time scale: presets are written in real seconds, cells
@@ -169,6 +179,13 @@ def make_adversary(spec: CellSpec):
         # REAL DKG rotations mid-run (mid-partition under the
         # partition-10s preset); split waves stall without a winner
         return VoteStormAdversary(seed=seed)
+    if name == "flood":
+        # max-rate valid-frame spam amplification from the last node
+        return FloodAdversary(flooder=n - 1, seed=seed)
+    if name == "future-spam":
+        # window-edge protocol spam: the receivers' future-epoch
+        # budgets and buffer caps must absorb it, counted
+        return FutureEpochSpamAdversary(spammer=n - 1, seed=seed)
     raise ValueError(f"unknown adversary {name!r} "
                      f"(known: {', '.join(ADVERSARIES)})")
 
@@ -198,6 +215,57 @@ def _timeline_digest(res: AuditResult) -> str:
         h.update(e.line.encode())
         h.update(b"\n")
     return h.hexdigest()[:24]
+
+
+def _sim_guard_doc(net, correct) -> Dict[str, Any]:
+    """Per-cell overload-defense witness for simulator cells: every
+    budgeted buffer's RUN-LONG peak depth vs its cap across the correct
+    nodes, plus the counted drops/evictions.  Peaks/evictions of epochs
+    that CLOSED during the run are preserved (``HoneyBadger`` folds
+    them into ``closed_guard`` when it deletes the epoch state) and
+    merged with the live instances' high-water marks, so the witness
+    covers the whole run, not just whatever was still open at the end.
+    The flood cells assert the peaks stay ≤ the caps (+1: peaks record
+    the pre-eviction length, falsifiably) while the cluster commits."""
+    aba_peak = aba_cap = 0
+    aba_evictions = 0
+    hb_drops = 0
+    subset_drops = 0
+    era_drops = 0
+    for nid in correct:
+        algo = net.nodes[nid].algorithm
+        algo = getattr(algo, "algo", algo)            # unwrap SenderQueue
+        dhb = getattr(algo, "dhb", algo)
+        hb = getattr(dhb, "hb", dhb)
+        era_drops += sum(getattr(dhb, "future_era_drops", {}).values())
+        hb_drops += sum(getattr(hb, "future_drops", {}).values())
+        closed = getattr(hb, "closed_guard", {})
+        aba_peak = max(aba_peak, closed.get("aba_future_peak", 0))
+        aba_evictions += closed.get("aba_future_evictions", 0)
+        subset_drops += closed.get("subset_flood_drops", 0)
+        for state in getattr(hb, "epochs", {}).values():
+            subset_drops += sum(state.subset.flood_drops.values())
+            for prop in state.subset.proposals.values():
+                ba = prop.agreement
+                aba_cap = max(aba_cap, ba.future_cap_per_sender)
+                aba_peak = max(aba_peak, ba.future_peak)
+                aba_evictions += sum(ba.future_evictions.values())
+    if aba_peak and not aba_cap:
+        # every live BA closed before the read: report the default cap
+        # so the folded peak still has its bound to compare against
+        from hbbft_tpu.protocols.binary_agreement import (
+            DEFAULT_MAX_FUTURE_EPOCHS, FUTURE_CAP_PER_EPOCH,
+        )
+
+        aba_cap = FUTURE_CAP_PER_EPOCH * (DEFAULT_MAX_FUTURE_EPOCHS + 1)
+    return {
+        "aba_future_peak": aba_peak,
+        "aba_future_cap": aba_cap,
+        "aba_future_evictions": aba_evictions,
+        "hb_future_drops": hb_drops,
+        "subset_flood_drops": subset_drops,
+        "future_era_drops": era_drops,
+    }
 
 
 def run_cell(spec: CellSpec, cell_dir: str
@@ -248,6 +316,10 @@ def run_cell(spec: CellSpec, cell_dir: str
         "shaping": net.shaper.stats() if net.shaper is not None else None,
         "adversary_filtered": net.adversary_filtered,
         "timeline_digest": _timeline_digest(res),
+        "guard": _sim_guard_doc(net, correct),
+        "overload_attributed_to": [
+            o["peer"] for o in res.overload_incidents
+        ],
         "journal": cell_dir,
     }
     return detail, res
@@ -349,13 +421,27 @@ def run_churn_cell(spec: CellSpec, cell_dir: str
 # ===========================================================================
 
 
+#: socket-kind adversaries driven by raw-socket injectors (everything
+#: else in the zoo is a simulator adversary)
+SOCKET_FLOOD_ADVERSARIES = ("garbage-stream", "flood")
+
+
 async def _socket_scenario(spec: CellSpec, cell_dir: str
                            ) -> Dict[str, Any]:
     """A real socket cluster at ``pipeline_depth > 1`` under a chaos
     preset at its REAL timings (wan latency in actual milliseconds):
     traffic must keep committing and the whole incident must audit
-    clean — the pipelined liveness point of the chaos trajectory."""
+    clean — the pipelined liveness point of the chaos trajectory.
+
+    With a flood adversary (``garbage-stream`` / ``flood``), a
+    raw-socket injector claiming the LAST validator's identity floods
+    node 0 while the cell's client traffic flows: the cluster must keep
+    committing, every budgeted buffer gauge must stay under its cap
+    (sampled live throughout the flood), and the guard's counted
+    throttles/disconnects must attribute the incident to the claimed
+    identity in the audit."""
     import asyncio
+    import contextlib
     import time
 
     from hbbft_tpu.net.cluster import (
@@ -364,6 +450,7 @@ async def _socket_scenario(spec: CellSpec, cell_dir: str
         find_free_base_port,
     )
 
+    flooding = spec.adversary in SOCKET_FLOOD_ADVERSARIES
     cfg = ClusterConfig(
         n=spec.n, seed=spec.seed, batch_size=spec.batch_size,
         base_port=find_free_base_port(spec.n),
@@ -372,10 +459,56 @@ async def _socket_scenario(spec: CellSpec, cell_dir: str
         pipeline_depth=spec.pipeline_depth,
         chaos=spec.shape if spec.shape != "none" else "",
         chaos_seed=spec.seed,
+        # flood cells tighten the ingress budgets so the guard engages
+        # within the cell's few-second window (production defaults are
+        # sized for sustained heavy traffic, not a short drill)
+        ingress_bytes_per_s=256 * 1024 if flooding else 0,
+        ingress_burst_bytes=128 * 1024 if flooding else 0,
+        ingress_decode_strikes=64 if flooding else 0,
+        ingress_throttle_strikes=8 if flooding else 0,
     )
     cluster = LocalCluster(cfg)
     await cluster.start()
+    injector = None
+    injector_task = None
+    gauge_peaks = {"senderq_buffered": 0, "inflight_frames": 0}
+    caps = {"senderq_buffered": None, "inflight_frames": None}
+    stop_sampling = asyncio.Event()
+
+    async def sample_gauges():
+        """Live witness that every budgeted buffer stays ≤ its cap for
+        the WHOLE run, not just at the end.  Reads only thread-safe
+        surfaces: the SenderQueue's own post-cap high-water mark (an
+        int maintained on the pump thread — iterating the live backlog
+        lists from this loop would race their mutations) and the
+        ingress budget's lock-protected peer table."""
+        while not stop_sampling.is_set():
+            for rt in cluster.runtimes:
+                sq = rt.sq
+                # the ASSERTABLE bounds: peaks are recorded pre-chop
+                # (+1 transient is legal) and the in-flight cap is
+                # enforced at recv-chunk granularity
+                caps["senderq_buffered"] = sq.buffered_cap + 1
+                caps["inflight_frames"] = (
+                    rt.transport.ingress.inflight_hard_bound)
+                gauge_peaks["senderq_buffered"] = max(
+                    gauge_peaks["senderq_buffered"], sq.buffered_peak)
+                for doc in rt.transport.ingress.peer_doc().values():
+                    gauge_peaks["inflight_frames"] = max(
+                        gauge_peaks["inflight_frames"], doc["inflight"])
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(stop_sampling.wait(), 0.1)
+
+    sampler = None
     try:
+        if flooding:
+            injector = GarbageStreamAdversary(
+                seed=spec.seed,
+                valid_frames=(spec.adversary == "flood"))
+            injector_task = asyncio.ensure_future(injector.run(
+                cluster.addrs[0], cfg.cluster_id, identity=spec.n - 1,
+                duration_s=20.0))
+        sampler = asyncio.ensure_future(sample_gauges())
         client = await cluster.client(0)
         txs = [b"sock-%04d" % i for i in range(spec.txs)]
         # hblint: disable=det-wall-clock (socket cells run a REAL-time
@@ -393,15 +526,49 @@ async def _socket_scenario(spec: CellSpec, cell_dir: str
         # hblint: disable=det-wall-clock (same measured-liveness read)
         wall = time.monotonic() - t0
         await cluster.wait_epochs(min_batches=1, timeout_s=60)
+        if injector_task is not None:
+            injector.budget_frames = 0  # stop flooding, then join
+            await asyncio.wait_for(injector_task, 30.0)
+            injector_task = None
         prefix = cluster.common_digest_prefix()
         batches = [len(rt.batches) for rt in cluster.runtimes]
-        return {
+        stop_sampling.set()
+        await sampler
+        out = {
             "batches_min": min(batches),
             "batches_max": max(batches),
             "commit_wall_s": round(wall, 3),
             "common_prefix_len": len(prefix),
         }
+        if flooding:
+            guard_docs = [rt.transport.ingress.as_dict()
+                          for rt in cluster.runtimes]
+            out["guard"] = {
+                "gauge_peaks": gauge_peaks,
+                "gauge_caps": caps,
+                "throttles": sum(g["throttles"] for g in guard_docs),
+                "disconnects": sum(g["disconnects"]
+                                   for g in guard_docs),
+                "decode_strikes": sum(g["decode_strikes"]
+                                      for g in guard_docs),
+                "hello_rejects": sum(g["hello_rejects"]
+                                     for g in guard_docs),
+                "injector": {
+                    "frames_sent": injector.frames_sent,
+                    "bytes_sent": injector.bytes_sent,
+                    "disconnects_observed": injector.disconnects,
+                },
+            }
+        return out
     finally:
+        stop_sampling.set()
+        if sampler is not None:
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await sampler
+        if injector_task is not None:
+            injector_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await injector_task
         await cluster.stop()
 
 
@@ -424,6 +591,11 @@ def run_socket_cell(spec: CellSpec, cell_dir: str
         "pipeline_depth": spec.pipeline_depth,
         "journal": cell_dir,
     }
+    if "guard" in live:
+        detail["guard"] = live["guard"]
+        detail["overload_attributed_to"] = [
+            o["peer"] for o in res.overload_incidents
+        ]
     return detail, res
 
 
@@ -442,9 +614,11 @@ def full_grid(seeds: Sequence[int] = (0, 1),
         for shape in PRESETS:
             for adv in ADVERSARIES:
                 limit = 40_000
-                if adv in ("equivocate", "vote-storm"):
+                if adv in ("equivocate", "vote-storm", "flood",
+                           "future-spam"):
                     # never-draining queues (equivocator re-proposals) /
-                    # multi-rotation storms need the longer leash
+                    # multi-rotation storms / injected spam waves need
+                    # the longer leash
                     limit = 60_000
                 specs.append(CellSpec(
                     shape=shape, adversary=adv, n=4, seed=seed,
@@ -470,6 +644,16 @@ def full_grid(seeds: Sequence[int] = (0, 1),
     for shape in ("wan-100ms", "dup-reorder", "lossy-1pct"):
         specs.append(CellSpec(kind="socket", shape=shape,
                               adversary="null", n=4, seed=0,
+                              pipeline_depth=2))
+    # socket flood cells (overload defense, end to end): a raw-socket
+    # injector claiming the last validator's identity streams garbage
+    # (framing-valid, decode-invalid) or max-rate valid frames at a live
+    # node — the cluster must keep committing, every buffer gauge stays
+    # under its cap, and the audit attributes the incident to the
+    # claimed peer from the journaled guard events
+    for adv in SOCKET_FLOOD_ADVERSARIES:
+        specs.append(CellSpec(kind="socket", shape="none",
+                              adversary=adv, n=4, seed=0,
                               pipeline_depth=2))
     return specs
 

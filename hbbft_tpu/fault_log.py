@@ -53,8 +53,16 @@ class FaultKind(enum.Enum):
     BatchDeserializationFailed = "honey_badger: contribution failed to deserialize"
     UnexpectedHbMessage = "honey_badger: message for an epoch outside the window"
     DecryptionFailed = "honey_badger: threshold decryption failed"
+    FutureEpochFlood = (
+        "honey_badger: per-sender future-epoch message budget exhausted "
+        "(window-edge spam; the message was dropped, counted)"
+    )
     # subset
     InvalidSubsetMessage = "subset: message for an unknown proposer"
+    SubsetMessageFlood = (
+        "subset: per-sender message budget for one ACS instance "
+        "exhausted (flood; the message was dropped, counted)"
+    )
     # dynamic honey badger / key gen
     InvalidVoteSignature = "dynamic_honey_badger: invalid vote signature"
     InvalidKeyGenMessage = "dynamic_honey_badger: invalid Part/Ack"

@@ -229,6 +229,7 @@ def all_checkers() -> List[Checker]:
     """Instantiate the full default suite (imports the checker modules)."""
     from hbbft_tpu.lint import (  # noqa: F401  (registration side effect)
         asyncio_hazard,
+        bounded_ingress,
         determinism,
         fault_accounting,
         metric_convention,

@@ -22,7 +22,7 @@ import struct
 import threading
 import time
 from collections import OrderedDict
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from hbbft_tpu.net import framing, transport
 from hbbft_tpu.net.framing import (
@@ -30,6 +30,19 @@ from hbbft_tpu.net.framing import (
     FrameDecoder,
     Hello,
 )
+
+
+class TxShedError(Exception):
+    """A previously-ACCEPTED transaction was shed by the node's
+    fair-admission guard and will never commit.  Raised promptly from
+    ``wait_committed`` (instead of a blind timeout) when the node
+    pushes the ``ACK_SHED`` notification; re-submission is the
+    caller's policy (the dedup window makes it cheap)."""
+
+    def __init__(self, digest: bytes):
+        super().__init__(f"tx {digest.hex()[:16]} shed by the mempool "
+                         f"fair-admission guard; re-submit if wanted")
+        self.digest = digest
 
 
 def tx_digest(tx: bytes) -> bytes:
@@ -63,6 +76,25 @@ class Mempool:
     well under ``wire.MAX_BLOB_BYTES`` (8 MiB) or its RBC shard messages
     would be undeliverable — reject at the door, not mid-broadcast.  The
     256 KiB default leaves a 4× margin at the default batch size of 8.
+
+    **Fair admission under FULL pressure** (overload defense): admission
+    is tracked per client id.  When the pool is full and the submitting
+    client holds LESS than its fair share (``capacity // active
+    clients``), the pool *sheds* the oldest pending transaction of the
+    most-over-share client — counted per shed client
+    (``hbbft_guard_mempool_sheds_total``) — and admits the newcomer,
+    instead of letting whichever client filled the pool first starve
+    everyone else.  At most ONE victim is shed per admission, and only
+    when that single shed actually makes the newcomer fit.  A shed
+    transaction was already acked ``ACCEPTED``; the runtime's
+    ``on_shed`` hook pulls it back out of the protocol queue and
+    pushes ``ACK_SHED`` to the clients, so a pending
+    ``wait_committed`` fails fast with :class:`TxShedError` instead of
+    riding out its timeout — re-submission is the caller's policy (the
+    dedup window makes it cheap).  Clients that stay under their share
+    are never shed, and the share divisor is clamped
+    (``fair_clients_max``) so a swarm of self-declared sybil client
+    ids cannot grind an honest bulk client's allocation toward zero.
     """
 
     ACCEPTED = framing.ACK_ACCEPTED
@@ -90,11 +122,36 @@ class Mempool:
         self.pending_bytes = 0
         self._pending: "OrderedDict[bytes, bytes]" = OrderedDict()  # digest→tx
         self._seen: "OrderedDict[bytes, None]" = OrderedDict()  # recent commits
+        # fair-admission bookkeeping: who owns each pending digest, how
+        # many each client holds, and each client's digests in FIFO
+        # order (the shed victim is the hog's OLDEST pending tx)
+        self._owners: Dict[bytes, str] = {}
+        self._client_counts: Dict[str, int] = {}
+        self._client_bytes: Dict[str, int] = {}
+        self._client_fifo: Dict[str, List[bytes]] = {}
+        self._fifo_stale: Dict[str, int] = {}
+        # per-victim shed tallies, key-capped like the metric registry
+        # (attacker-minted client ids must not grow this dict or the
+        # /status payload without bound)
+        self.sheds: Dict[str, int] = {}
+        self._sheds_key_cap = 32
+        # fair-share floor against sybil client ids: client identities
+        # are self-declared, so the share divisor is clamped — a swarm
+        # of minted ids can displace an honest bulk client down to
+        # capacity/fair_clients_max pending txs, never to zero
+        self.fair_clients_max = 32
+        # a shed tx was already handed to the consensus layer at
+        # admission; the owner (NodeRuntime) hooks this to pull it back
+        # out of the protocol queue so shedding really sheds —
+        # otherwise every shed would grow the protocol queue past the
+        # mempool's ceiling
+        self.on_shed: Optional[Callable[[bytes], None]] = None
         # admission (event loop) and commit pruning (the runtime's pump
         # worker) run on different threads since the pipelined scheduler;
         # the compound size/byte-budget invariants need this lock
         self._lock = threading.Lock()
         self._acks = None
+        self._sheds = None
         if registry is not None:
             self.bind_registry(registry)
 
@@ -109,14 +166,23 @@ class Mempool:
         )
         for name in self._ACK_NAMES.values():
             self._acks.labels(status=name)
+        self._sheds = registry.counter(
+            "hbbft_guard_mempool_sheds_total",
+            "pending transactions shed under FULL pressure to admit an "
+            "under-share client's tx, labeled by the SHED client",
+            labelnames=("client",), max_label_sets=33)
         g_pending = registry.gauge(
             "hbbft_node_mempool_pending", "not-yet-committed transactions")
         g_bytes = registry.gauge(
             "hbbft_node_mempool_pending_bytes",
             "bytes held by pending transactions")
+        g_clients = registry.gauge(
+            "hbbft_guard_mempool_clients",
+            "distinct clients with pending transactions")
         registry.register_callback(lambda: (
             g_pending.set(len(self._pending)),
             g_bytes.set(self.pending_bytes),
+            g_clients.set(len(self._client_counts)),
         ))
 
     def _count(self, status: int) -> int:
@@ -124,19 +190,127 @@ class Mempool:
             self._acks.labels(status=self._ACK_NAMES[status]).inc()
         return status
 
-    def add(self, tx: bytes) -> int:
+    def add(self, tx: bytes, client_id: str = "_anon") -> int:
         if len(tx) > self.max_tx_bytes:
             return self._count(self.REJECTED)
         digest = tx_digest(tx)
-        with self._lock:
-            if digest in self._pending or digest in self._seen:
-                return self._count(self.DUPLICATE)
-            if (len(self._pending) >= self.capacity
-                    or self.pending_bytes + len(tx) > self.max_pending_bytes):
-                return self._count(self.FULL)
-            self._pending[digest] = tx
-            self.pending_bytes += len(tx)
-        return self._count(self.ACCEPTED)
+        shed_tx: Optional[bytes] = None
+        try:
+            with self._lock:
+                if digest in self._pending or digest in self._seen:
+                    return self._count(self.DUPLICATE)
+                if (len(self._pending) >= self.capacity
+                        or self.pending_bytes + len(tx)
+                        > self.max_pending_bytes):
+                    # at most ONE victim per admission, and only when
+                    # that single shed actually makes the newcomer fit
+                    # — never destroy acked state for a FULL anyway
+                    shed_tx = self._shed_for(client_id, len(tx))
+                    if shed_tx is None:
+                        return self._count(self.FULL)
+                self._admit(digest, tx, client_id)
+            return self._count(self.ACCEPTED)
+        finally:
+            # outside the lock: the hook re-enters the runtime (pump
+            # enqueue)
+            if shed_tx is not None and self.on_shed is not None:
+                self.on_shed(shed_tx)
+
+    def _admit(self, digest: bytes, tx: bytes, client_id: str) -> None:
+        self._pending[digest] = tx
+        self.pending_bytes += len(tx)
+        self._owners[digest] = client_id
+        self._client_counts[client_id] = (
+            self._client_counts.get(client_id, 0) + 1
+        )
+        self._client_bytes[client_id] = (
+            self._client_bytes.get(client_id, 0) + len(tx)
+        )
+        self._client_fifo.setdefault(client_id, []).append(digest)
+
+    def _shed_for(self, client_id: str,
+                  need_bytes: int) -> Optional[bytes]:
+        """Shed ONE pending tx to make room for ``client_id`` — only if
+        the submitter is UNDER its fair share, some other client is
+        over it, and removing that single victim actually admits a
+        ``need_bytes`` newcomer (feasibility first: acked state is
+        never destroyed for a FULL anyway).  Returns the shed tx bytes
+        (for the ``on_shed`` hook) or None.  Caller holds the lock."""
+        # the submitter counts as active even before its first
+        # admission — that is exactly the starvation case.  The divisor
+        # is clamped (`fair_clients_max`): client ids are self-declared,
+        # and an unclamped share would let a sybil swarm grind an
+        # honest bulk client's allocation toward zero.  Pressure is the
+        # worse of the COUNT share and the BYTE share — a client that
+        # filled max_pending_bytes with a few huge txs is just as much
+        # over its share as one that filled the entry count.
+        active = len(self._client_counts) + (
+            0 if client_id in self._client_counts else 1)
+        denom = max(1, min(active, self.fair_clients_max))
+        count_share = max(1, self.capacity // denom)
+        byte_share = max(1, self.max_pending_bytes // denom)
+
+        def pressure(c: str) -> float:
+            return max(
+                self._client_counts.get(c, 0) / count_share,
+                self._client_bytes.get(c, 0) / byte_share,
+            )
+
+        if pressure(client_id) >= 1.0:
+            return None
+        victim = max(self._client_counts,
+                     key=lambda c: (pressure(c), c), default=None)
+        if (victim is None or victim == client_id
+                or pressure(victim) <= 1.0):
+            return None
+        fifo = self._client_fifo.get(victim, [])
+        while fifo:
+            digest = fifo[0]
+            dropped = self._pending.get(digest)
+            if dropped is None:
+                fifo.pop(0)
+                continue  # already committed; stale fifo entry
+            if (len(self._pending) - 1 >= self.capacity
+                    or self.pending_bytes - len(dropped) + need_bytes
+                    > self.max_pending_bytes):
+                return None  # one shed would not admit the newcomer
+            fifo.pop(0)
+            del self._pending[digest]
+            self.pending_bytes -= len(dropped)
+            self._forget_owner(digest, len(dropped))
+            key = victim
+            if (key not in self.sheds
+                    and len(self.sheds) >= self._sheds_key_cap):
+                key = "_overflow_"        # bounded like the registry
+            self.sheds[key] = self.sheds.get(key, 0) + 1
+            if self._sheds is not None:
+                self._sheds.labels(client=victim).inc()
+            return dropped
+        return None
+
+    def _forget_owner(self, digest: bytes, nbytes: int) -> None:
+        owner = self._owners.pop(digest, None)
+        if owner is None:
+            return
+        left = self._client_counts.get(owner, 0) - 1
+        if left > 0:
+            self._client_counts[owner] = left
+            self._client_bytes[owner] = max(
+                0, self._client_bytes.get(owner, 0) - nbytes)
+            # committed digests go stale in the owner's FIFO (removing
+            # them eagerly would be O(n) per commit); compact once the
+            # stale fraction dominates so the list itself stays bounded
+            stale = self._fifo_stale.get(owner, 0) + 1
+            fifo = self._client_fifo.get(owner)
+            if fifo is not None and stale * 2 > len(fifo):
+                fifo[:] = [d for d in fifo if d in self._pending]
+                stale = 0
+            self._fifo_stale[owner] = stale
+        else:
+            self._client_counts.pop(owner, None)
+            self._client_bytes.pop(owner, None)
+            self._client_fifo.pop(owner, None)
+            self._fifo_stale.pop(owner, None)
 
     def mark_committed(self, txs) -> List[bytes]:
         """Drop committed txs from pending; returns their digests."""
@@ -148,6 +322,7 @@ class Mempool:
                 dropped = self._pending.pop(digest, None)
                 if dropped is not None:
                     self.pending_bytes -= len(dropped)
+                    self._forget_owner(digest, len(dropped))
                 self._seen[digest] = None
             while len(self._seen) > self.seen_cap:
                 self._seen.popitem(last=False)
@@ -426,6 +601,15 @@ class ClusterClient:
     def _on_frame(self, kind: int, payload: bytes) -> None:
         if kind == framing.TX_ACK:
             status, digest = payload[0], payload[1:33]
+            if status == framing.ACK_SHED:
+                # push notification, not a reply to a written TX frame:
+                # fail the commit waiters NOW instead of letting them
+                # ride out the full timeout on a tx that can never land
+                self._submit_times.pop(digest, None)
+                for fut in self._commits.pop(digest, ()) or ():
+                    if not fut.done():
+                        fut.set_exception(TxShedError(digest))
+                return
             waiters = self._acks.get(digest)
             if waiters:
                 fut = waiters.pop(0)  # one ack per written TX frame: FIFO
@@ -447,6 +631,9 @@ class ClusterClient:
                     continue  # someone else's transaction
                 lat = now - t0 if t0 is not None else 0.0
                 if t0 is not None:
+                    # hblint: disable=bounded-ingress (one entry per tx
+                    # THIS client submitted — caller-controlled load-
+                    # generator measurement data, not peer-driven growth)
                     self.latencies.append((digest.hex(), lat))
                 self._committed[digest] = lat
                 while len(self._committed) > self._committed_cap:

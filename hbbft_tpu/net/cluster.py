@@ -100,6 +100,15 @@ class ClusterConfig:
     # "0:0.04,3:0.02") — a heterogeneous cluster where every validator
     # runs at its own speed; entries here override slow_node/slow_delay_s
     step_delays: str = ""
+    # ingress-budget overrides (overload defense, net/transport.py):
+    # 0 keeps the IngressBudget defaults (sized far above honest
+    # traffic); flood chaos cells tighten them so the guard engages
+    # within a short run
+    ingress_bytes_per_s: float = 0.0
+    ingress_burst_bytes: float = 0.0
+    ingress_max_inflight: int = 0
+    ingress_decode_strikes: int = 0
+    ingress_throttle_strikes: int = 0
     # class-selective shaping: the listed nodes ("0,1") hold their
     # outbound BINARY-AGREEMENT traffic (BVal/Aux/Conf/Coin/Term) for
     # `aba_out_delay_s` while RBC flows normally.  Decorrelating ABA
@@ -156,6 +165,21 @@ class ClusterConfig:
 
         seed = self.seed if self.chaos_seed < 0 else self.chaos_seed
         return LinkShaper(preset_shape(self.chaos, self.n), seed=seed)
+
+    def ingress_kwargs(self) -> Optional[Dict[str, float]]:
+        """Non-default IngressBudget overrides, or None (defaults)."""
+        out: Dict[str, float] = {}
+        if self.ingress_bytes_per_s > 0:
+            out["bytes_per_s"] = self.ingress_bytes_per_s
+        if self.ingress_burst_bytes > 0:
+            out["burst_bytes"] = self.ingress_burst_bytes
+        if self.ingress_max_inflight > 0:
+            out["max_inflight_frames"] = self.ingress_max_inflight
+        if self.ingress_decode_strikes > 0:
+            out["decode_strikes"] = self.ingress_decode_strikes
+        if self.ingress_throttle_strikes > 0:
+            out["throttle_strikes"] = self.ingress_throttle_strikes
+        return out or None
 
     def aba_delay_for(self, nid: int) -> float:
         """This node's outbound ABA-class hold, from aba_delay_nodes."""
@@ -258,6 +282,7 @@ def _shared_runtime_kwargs(cfg: ClusterConfig, nid: int) -> dict:
         step_delay_s=cfg.step_delay_for(nid),
         aba_out_delay_s=cfg.aba_delay_for(nid),
         aba_out_classes=cfg.aba_out_classes,
+        ingress_kwargs=cfg.ingress_kwargs(),
     )
 
 
@@ -591,19 +616,19 @@ def node_command(cfg: ClusterConfig, nid: int) -> List[str]:
     return cmd
 
 
-def spawn_node(cfg: ClusterConfig, nid: int,
+def spawn_node(cfg: ClusterConfig, nid: int, *, join: bool = False,
                **popen_kwargs) -> subprocess.Popen:
     """One node as a child process (forces the CPU jax backend so node
-    processes never grab an accelerator)."""
+    processes never grab an accelerator).  ``join=True`` spawns the
+    state-sync joiner flow (``--join``) instead of a genesis member."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.setdefault("HBBFT_PLAIN_LADDER", "1")
     cwd = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)
     )))
-    return subprocess.Popen(
-        node_command(cfg, nid), env=env, cwd=cwd, **popen_kwargs
-    )
+    cmd = join_command(cfg, nid) if join else node_command(cfg, nid)
+    return subprocess.Popen(cmd, env=env, cwd=cwd, **popen_kwargs)
 
 
 async def connect_when_up(cfg: ClusterConfig, nid: int, *,
@@ -637,6 +662,87 @@ def shutdown_procs(procs, timeout_s: float = 15.0) -> None:
         # a node ignoring SIGTERM for timeout_s is SIGKILLed)
         except subprocess.TimeoutExpired:
             p.kill()
+
+
+async def _serve_runtime(rt: NodeRuntime) -> None:
+    """Serve a started runtime until SIGTERM/SIGINT (shared tail of
+    ``run_node`` and ``run_join_node``): a dead step pump is a dead
+    node, so its exception is surfaced instead of serving sockets for a
+    consensus engine that no longer runs."""
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    stop_task = asyncio.ensure_future(stop.wait())
+    done, _pending = await asyncio.wait(
+        {stop_task, rt.pump.task}, return_when=asyncio.FIRST_COMPLETED
+    )
+    if rt.pump.task in done:
+        stop_task.cancel()
+        exc = rt.pump.task.exception()
+        if exc is not None:
+            raise exc
+
+
+async def run_join_node(cfg: ClusterConfig, nid: int,
+                        metrics_port: int = 0,
+                        donors: Optional[List[int]] = None,
+                        min_manifest_confirm: int = 2) -> None:
+    """Join a LIVE cluster as a fresh OS process — the multi-process
+    face of the PR-8 membership lifecycle (``LocalCluster.join_node``
+    drives the same path in-process):
+
+    1. the existing validators must already have voted ``nid`` in (its
+       config-derived public key) and completed the DKG rotation, so
+       every donor serves an era-boundary join snapshot;
+    2. this process state-syncs the snapshot from the donors (chunked,
+       CRC'd, multi-donor-confirmed — ``net/statesync.py``), derives
+       its secret key share from the committed DKG transcript, and
+    3. activates at the era boundary with zero history replay, dialing
+       every existing member; members accept its hello through the
+       membership-resolved dynamic-peer path and dial back.
+
+    ``python -m hbbft_tpu.net.cluster --join --node-id I …`` lands here.
+    """
+    from hbbft_tpu.net.statesync import StateSyncClient
+
+    donor_ids = [d for d in (donors if donors is not None
+                             else range(cfg.n)) if d != nid]
+    if not donor_ids:
+        raise ValueError("--join needs at least one donor node")
+    snap = await StateSyncClient(
+        [cfg.addr(d) for d in donor_ids], cfg.cluster_id,
+        client_id=f"statesync-{nid}", seed=cfg.seed,
+        min_manifest_confirm=min(min_manifest_confirm, len(donor_ids)),
+    ).fetch()
+    print(f"node {nid} state-synced era {snap.era} snapshot "
+          f"(chain len {snap.chain_len})", flush=True)
+    rt = build_joiner_runtime(cfg, snap, nid)
+    try:
+        host, port = cfg.addr(nid)
+        await rt.start(host, port)
+        if metrics_port:
+            m_host, m_port = await rt.start_obs(host, metrics_port)
+            print(f"node {nid} obs endpoint on http://{m_host}:{m_port}"
+                  f"/metrics", flush=True)
+        rt.connect({d: cfg.addr(d) for d in donor_ids})
+        print(f"node {nid} joined, listening on {host}:{port}",
+              flush=True)
+        await _serve_runtime(rt)
+    except BaseException as exc:
+        rt.flight_crash(exc)
+        raise
+    await rt.stop()
+
+
+def join_command(cfg: ClusterConfig, nid: int) -> List[str]:
+    """The ``--join`` subprocess invocation for ``nid`` under ``cfg``."""
+    cmd = node_command(cfg, nid)
+    # --node-id validation differs under --join (a joiner's id may be
+    # outside 0..n-1), so the flag must precede nothing in particular —
+    # append is fine
+    cmd.append("--join")
+    return cmd
 
 
 async def run_node(cfg: ClusterConfig, nid: int,
@@ -676,22 +782,8 @@ async def run_node(cfg: ClusterConfig, nid: int,
             print(f"node {nid} obs endpoint on http://{m_host}:{m_port}"
                   f"/metrics", flush=True)
         rt.connect(cfg.addr_map())
-        stop = asyncio.Event()
-        loop = asyncio.get_running_loop()
-        for sig in (signal.SIGTERM, signal.SIGINT):
-            loop.add_signal_handler(sig, stop.set)
         print(f"node {nid} listening on {host}:{port}", flush=True)
-        # a dead step pump is a dead node: surface its exception instead
-        # of serving sockets for a consensus engine that no longer runs
-        stop_task = asyncio.ensure_future(stop.wait())
-        done, _pending = await asyncio.wait(
-            {stop_task, rt.pump.task}, return_when=asyncio.FIRST_COMPLETED
-        )
-        if rt.pump.task in done:
-            stop_task.cancel()
-            exc = rt.pump.task.exception()
-            if exc is not None:
-                raise exc
+        await _serve_runtime(rt)
     except BaseException as exc:
         # crash-dump flush: make the black box land on disk before the
         # process dies, whatever killed it
@@ -754,8 +846,18 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--aba-out-classes", default="",
                     help="narrow --aba-out-delay to these phase classes "
                          "(comma list, e.g. aba_conf); empty = all aba_*")
+    ap.add_argument("--join", action="store_true",
+                    help="join a LIVE cluster via snapshot state-sync "
+                         "instead of starting from genesis: the "
+                         "existing validators must already have voted "
+                         "this node id in (DKG rotation complete); "
+                         "--node-id may exceed --nodes-1 for a brand-"
+                         "new validator")
     args = ap.parse_args(argv)
-    if not 0 <= args.node_id < args.nodes:
+    if args.join:
+        if args.node_id < 0:
+            ap.error(f"--node-id {args.node_id} must be >= 0")
+    elif not 0 <= args.node_id < args.nodes:
         ap.error(f"--node-id {args.node_id} not in 0..{args.nodes - 1}")
     cfg = ClusterConfig(
         n=args.nodes, seed=args.seed, base_port=args.base_port,
@@ -770,8 +872,12 @@ def main(argv: Optional[List[str]] = None) -> None:
         aba_out_delay_s=args.aba_out_delay,
         aba_out_classes=args.aba_out_classes,
     )
-    asyncio.run(run_node(cfg, args.node_id,
-                         metrics_port=args.metrics_port))
+    if args.join:
+        asyncio.run(run_join_node(cfg, args.node_id,
+                                  metrics_port=args.metrics_port))
+    else:
+        asyncio.run(run_node(cfg, args.node_id,
+                             metrics_port=args.metrics_port))
 
 
 if __name__ == "__main__":

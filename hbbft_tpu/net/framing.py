@@ -61,6 +61,9 @@ ACK_ACCEPTED = 0
 ACK_DUPLICATE = 1
 ACK_FULL = 2       # backpressure: retry later
 ACK_REJECTED = 3   # oversized: never retry
+ACK_SHED = 4       # push notification: a previously-ACCEPTED tx was
+                   # shed under fair-admission pressure and will not
+                   # commit — re-submit if still wanted
 
 ROLE_NODE = 0x01
 ROLE_CLIENT = 0x02

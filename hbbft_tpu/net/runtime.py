@@ -84,7 +84,7 @@ class _PumpOutcome:
     loop after the worker thread returns: coalesced outbound payloads per
     destination (insertion-ordered) and client commit notifications."""
 
-    __slots__ = ("frames", "frames_delayed", "commits", "cpu_s")
+    __slots__ = ("frames", "frames_delayed", "commits", "sheds", "cpu_s")
 
     def __init__(self):
         self.frames: Dict[NodeId, List[bytes]] = {}
@@ -93,6 +93,9 @@ class _PumpOutcome:
         # never head-block the fast classes)
         self.frames_delayed: Dict[NodeId, List[bytes]] = {}
         self.commits: List[Tuple[int, int, List[bytes]]] = []
+        # digests of mempool-shed txs: clients are notified (ACK_SHED
+        # push) so their commit waits fail fast instead of timing out
+        self.sheds: List[bytes] = []
         # CPU seconds this iteration actually burned (thread time, immune
         # to preemption on a contended host) — drives the pump's
         # inline-vs-executor decision
@@ -196,6 +199,21 @@ class NodeRuntime:
         self._c_committed = self.registry.counter(
             "hbbft_node_committed_txs_total", "transactions committed")
         self._c_faults = fault_counter(self.registry)
+        # hbbft_guard_*: the overload-defense metric family (transport
+        # ingress budgets register theirs on the same registry below)
+        self._c_sq_evict = self.registry.counter(
+            "hbbft_guard_senderq_evictions_total",
+            "SenderQueue backlog entries front-chopped at the per-peer "
+            "cap (the peer recovers via snapshot state-sync)",
+            labelnames=("peer",), max_label_sets=33)
+        self._c_proto_drops = self.registry.counter(
+            "hbbft_guard_protocol_drops_total",
+            "messages dropped by protocol-layer flood budgets "
+            "(hb_future = HoneyBadger future-epoch budget, subset = "
+            "per-ACS sender budget)",
+            labelnames=("kind",), max_label_sets=4)
+        for k in ("hb_future", "subset"):
+            self._c_proto_drops.labels(kind=k)
         self.registry.register_callback(self._refresh_gauges)
         self.mempool = mempool or Mempool()
         self.mempool.bind_registry(self.registry)
@@ -293,6 +311,20 @@ class NodeRuntime:
             peer_resolver=self._resolve_peer,
             **transport_kwargs,
         )
+        # overload-defense wiring: the transport meters per-peer ingress
+        # (frames admitted here retire in _process_peer_message), the
+        # runtime reports decode-garbage strikes back to it, and every
+        # guard escalation is journaled through the pump so the forensic
+        # auditor can attribute the incident to the offending peer
+        self.transport.ingress.track_inflight = True
+        self.transport.ingress.on_event = self._on_guard_event
+        self.sq.on_evict = self._on_senderq_evict
+        # a shed tx was pump-enqueued at admission: pull it back out of
+        # the protocol queue too, or every shed would grow the queue
+        # past the mempool ceiling (an unproposed shed tx then truly
+        # never commits; one already riding an open epoch still lands —
+        # proposals cannot be recalled)
+        self.mempool.on_shed = self._on_mempool_shed
         self._obs_server: Optional[ObsServer] = None
         self.obs_addr: Optional[Addr] = None
         # HBBFT_PUMP_TIMING=1: accumulate per-segment thread time in the
@@ -387,6 +419,45 @@ class NodeRuntime:
                 continue
             g_pera.labels(peer=repr(peer)).set(p_era)
             g_pep.labels(peer=repr(peer)).set(p_epoch)
+        # overload-defense gauges: every budgeted buffer's depth, per
+        # peer — the "pinned under its cap" witnesses the chaos cells
+        # (and operators) assert on
+        g_sqb = r.gauge(
+            "hbbft_guard_senderq_buffered",
+            "SenderQueue backlog entries held for each peer "
+            "(capped at buffered_cap; overflow front-chops, counted)",
+            labelnames=("peer",), max_label_sets=33)
+        for peer, entries in list(self.sq.buffered.items()):
+            g_sqb.labels(peer=repr(peer)).set(len(entries))
+        g_aba = r.gauge(
+            "hbbft_guard_aba_future_buffered",
+            "largest per-sender ABA future-epoch buffer across live "
+            "agreement instances (capped at future_cap_per_sender)",
+            labelnames=("peer",), max_label_sets=33)
+        for peer, depth in self._aba_future_depths().items():
+            g_aba.labels(peer=repr(peer)).set(depth)
+
+    def _aba_future_depths(self) -> Dict[NodeId, int]:
+        """max per-sender future-buffer depth over live BA instances."""
+        out: Dict[NodeId, int] = {}
+        hb = self._inner_hb()
+        if hb is None:
+            return out
+        try:
+            for state in list(hb.epochs.values()):
+                for prop in list(state.subset.proposals.values()):
+                    per: Dict[NodeId, int] = {}
+                    for sender, _msg in list(prop.agreement.future):
+                        per[sender] = per.get(sender, 0) + 1
+                    for sender, n in per.items():
+                        if n > out.get(sender, 0):
+                            out[sender] = n
+        # hblint: disable=fault-swallowed-drop (nothing is dropped: this
+        # is a best-effort gauge sample racing the pump thread's
+        # mutations; the next scrape re-reads the live state)
+        except RuntimeError:
+            pass
+        return out
 
     def _inner_hb(self):
         """The innermost HoneyBadger of the wrapped stack, if any."""
@@ -484,13 +555,31 @@ class NodeRuntime:
 
     def submit_tx(self, tx: bytes) -> int:
         """Local admission (same path as a client TX frame)."""
-        status = self.mempool.add(tx)
+        status = self.mempool.add(tx, client_id="_local")
         if status == Mempool.ACCEPTED:
             self.pump.enqueue("input", self.make_tx_input(tx))
         return status
 
     def _on_peer_message(self, peer_id: NodeId, payload: bytes) -> None:
         self.pump.enqueue("msg", peer_id, payload)
+
+    def _on_guard_event(self, kind: str, peer_id: NodeId,
+                        detail: str) -> None:
+        """Transport ingress-guard escalations (event loop side): queue
+        them through the pump so the journal append — which the pump's
+        worker thread owns — stays single-threaded."""
+        self.pump.enqueue("guard", kind, peer_id, detail)
+
+    def _on_mempool_shed(self, tx: bytes) -> None:
+        self.pump.enqueue("shed", tx)
+
+    def _on_senderq_evict(self, peer_id: NodeId, n: int) -> None:
+        """SenderQueue backlog eviction (pump thread): count and
+        journal, attributing the overflow to the backlogged peer."""
+        self._c_sq_evict.labels(peer=repr(peer_id)).inc(n)
+        if self.flight is not None:
+            self.flight.on_note(
+                "guard", f"kind=senderq_evict peer={peer_id!r} n={n}")
 
     def _on_peer_hello(self, peer_id: NodeId, hello, direction: str) -> None:
         # ordering with the peer's subsequent messages is preserved by the
@@ -523,6 +612,10 @@ class NodeRuntime:
                         self._process_peer_hello(*args)
                     elif kind == "startup":
                         self._absorb(self.sq.startup_step())
+                    elif kind == "guard":
+                        self._process_guard_event(*args)
+                    elif kind == "shed":
+                        self._process_shed(args[0])
                     else:  # pragma: no cover - enqueue() callers are local
                         raise ValueError(f"unknown pump event {kind!r}")
                 self._drain_deferred()
@@ -561,6 +654,10 @@ class NodeRuntime:
                 self._process_peer_hello(*args)
             elif kind == "startup":
                 self._absorb(self.sq.startup_step())
+            elif kind == "guard":
+                self._process_guard_event(*args)
+            elif kind == "shed":
+                self._process_shed(args[0])
             else:  # pragma: no cover - enqueue() callers are local
                 raise ValueError(f"unknown pump event {kind!r}")
             timing[kind] = timing.get(kind, 0.0) + (tt() - t0)
@@ -613,6 +710,14 @@ class NodeRuntime:
                                 dest, payloads)
         for era, epoch, digests in out.commits:
             self._notify_commit(era, epoch, digests)
+        for digest in out.sheds:
+            # ACK_SHED push: every client sees it; only the one holding
+            # the digest's commit waiters reacts (the others ignore it)
+            for conn in list(self._clients):
+                conn.send(framing.TX_ACK,
+                          bytes([framing.ACK_SHED]) + digest)
+                if conn.closed:
+                    self._clients.discard(conn)
 
     def _send_shaped(self, dest: NodeId, payloads: List[bytes]) -> None:
         try:
@@ -622,7 +727,35 @@ class NodeRuntime:
             logger.warning("no transport peer for %r: dropped %d shaped "
                            "payloads", dest, len(payloads))
 
+    def _process_guard_event(self, kind: str, peer_id: NodeId,
+                             detail: str) -> None:
+        """Journal a transport guard escalation (pump thread — the one
+        place journal appends are allowed)."""
+        if self.flight is not None:
+            self.flight.on_note("guard",
+                                f"kind={kind} peer={peer_id!r} {detail}")
+
+    def _process_shed(self, tx: bytes) -> None:
+        """A mempool shed (pump thread): drop the tx from the protocol
+        queue so the shed frees consensus-side memory too, not just
+        mempool bookkeeping — and queue the client push notification
+        (written by pump_flush on the event loop).  The notification is
+        DEFINITIVE: it is suppressed when the tx was no longer in the
+        queue or is riding a not-yet-committed proposal (a proposal
+        cannot be recalled, so such a tx may still commit — the client
+        must not be told it never will)."""
+        tx = bytes(tx)
+        queue = getattr(self.sq.algo, "queue", None)
+        if queue is None:
+            return
+        removed = queue.remove_multiple({tx})
+        in_flight = getattr(self.sq.algo, "in_flight_txs", None)
+        riding = in_flight is not None and tx in in_flight()
+        if removed and not riding and self._out is not None:
+            self._out.sheds.append(tx_digest(tx))
+
     def _process_peer_message(self, peer_id: NodeId, payload: bytes) -> None:
+        self.transport.ingress.frame_done(peer_id)
         timing = self._pump_timing
         t0 = time.thread_time() if timing is not None else 0.0
         # Decode memo: wire messages are frozen/immutable, and much of an
@@ -640,6 +773,7 @@ class NodeRuntime:
                 msg = wire.decode_message(payload)
             except ValueError as exc:
                 self.decode_failures += 1
+                self.transport.ingress.decode_strike(peer_id)
                 logger.warning("undecodable message from %r: %s",
                                peer_id, exc)
                 return
@@ -648,6 +782,7 @@ class NodeRuntime:
             cache[payload] = msg
         if not isinstance(msg, (AlgoMessage, EpochStarted)):
             self.decode_failures += 1
+            self.transport.ingress.decode_strike(peer_id)
             logger.warning("non-sender-queue message %s from %r",
                            type(msg).__name__, peer_id)
             return
@@ -665,8 +800,10 @@ class NodeRuntime:
         except TypeError as exc:
             # decodable but protocol-unexpected (e.g. AlgoMessage wrapping
             # a bare ReadyMsg): Byzantine input at the network boundary —
-            # count it, keep the connection and the loop alive
+            # count it, keep the connection and the loop alive (the
+            # guard's strike ladder disconnects a sustained stream)
             self.decode_failures += 1
+            self.transport.ingress.decode_strike(peer_id)
             logger.warning("protocol-rejected message from %r: %s",
                            peer_id, exc)
             return
@@ -726,6 +863,11 @@ class NodeRuntime:
         try:
             for fault in step.fault_log:
                 self._c_faults.labels(kind=fault.kind.name).inc()
+                name = fault.kind.name
+                if name == "FutureEpochFlood":
+                    self._c_proto_drops.labels(kind="hb_future").inc()
+                elif name == "SubsetMessageFlood":
+                    self._c_proto_drops.labels(kind="subset").inc()
             self.spans.on_step(step)
             if self.flight is not None:
                 self.flight.on_step(step)
@@ -929,10 +1071,12 @@ class NodeRuntime:
             return
         self._clients.add(conn)
         if kind == framing.TX:
-            # admission (bounded, dedup'd) and the ack stay on the event
-            # loop — backpressure must not wait behind a pump iteration;
-            # only the accepted input crosses into the pump
-            status = self.mempool.add(payload)
+            # admission (bounded, dedup'd, FAIR per client under FULL
+            # pressure) and the ack stay on the event loop — backpressure
+            # must not wait behind a pump iteration; only the accepted
+            # input crosses into the pump
+            status = self.mempool.add(payload,
+                                      client_id=str(conn.client_id))
             conn.send(framing.TX_ACK, bytes([status]) + tx_digest(payload))
             if status == Mempool.ACCEPTED:
                 self.pump.enqueue("input", self.make_tx_input(payload))
@@ -983,6 +1127,21 @@ class NodeRuntime:
                 }
                 if self.sync_store.manifest is not None else None
             ),
+            "guard": {
+                "ingress": self.transport.ingress.as_dict(),
+                "senderq_evictions": int(self._c_sq_evict.total()),
+                "senderq_buffered": {
+                    repr(p): len(e)
+                    for p, e in list(self.sq.buffered.items())
+                },
+                "protocol_drops": {
+                    "hb_future": int(self._c_proto_drops.value(
+                        kind="hb_future")),
+                    "subset": int(self._c_proto_drops.value(
+                        kind="subset")),
+                },
+                "mempool_sheds": dict(self.mempool.sheds),
+            },
             "faults_observed": self.faults_observed,
             "peers_connected": sum(
                 1 for p in self.transport.peer_ids()

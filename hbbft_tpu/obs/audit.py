@@ -61,6 +61,24 @@ from hbbft_tpu.protocols import wire
 _RANK = {"note": 0, "msg": 1, "commit": 2, "span": 3, "fault": 4}
 
 
+#: FlightFault kinds that are protocol-layer overload evidence (flood
+#: budgets engaging), as opposed to protocol misbehavior of other shapes
+_OVERLOAD_FAULT_KINDS = frozenset({
+    "FutureEpochFlood", "SubsetMessageFlood",
+})
+
+
+def _parse_guard_note(detail: str) -> Optional[Dict[str, str]]:
+    """``kind=K peer=P …`` → {kind, peer} (the runtime's overload-guard
+    journal format; see NodeRuntime._process_guard_event)."""
+    fields = dict(
+        part.split("=", 1) for part in detail.split() if "=" in part
+    )
+    if "kind" not in fields or "peer" not in fields:
+        return None
+    return {"kind": fields["kind"], "peer": fields["peer"]}
+
+
 def _parse_statesync_note(detail: str) -> Optional[Dict[str, Any]]:
     """``index=N head=HEX`` → {index, head} (the boundary a snapshot
     joiner's runtime journals at activation)."""
@@ -203,6 +221,13 @@ class AuditResult:
     # sender never journaled sending — the tampering shape) still is.
     restart_reproposals: List[Dict[str, Any]] = field(
         default_factory=list)
+    # resource-exhaustion forensics: journaled ``guard`` notes (ingress
+    # throttle escalations, SenderQueue backlog evictions, hello rejects
+    # — written by the runtime's overload defense) plus protocol-layer
+    # flood faults (FutureEpochFlood / SubsetMessageFlood), aggregated
+    # per OFFENDING peer so an incident attributes to the spamming node.
+    # Defense working as designed is not a fault verdict.
+    overload_incidents: List[Dict[str, Any]] = field(default_factory=list)
 
     @property
     def first_affected_epoch(self) -> Optional[Tuple[int, int]]:
@@ -240,6 +265,7 @@ class AuditResult:
             "sync_joins": self.sync_joins,
             "sync_mismatches": self.sync_mismatches,
             "restart_reproposals": self.restart_reproposals,
+            "overload_incidents": self.overload_incidents,
         }
 
 
@@ -268,6 +294,15 @@ def audit(journals: List[Journal]) -> AuditResult:
     # from equivocation/tampering
     slot_sends: Dict[Tuple, Dict[str, set]] = {}
     commits: Dict[str, Dict[int, Tuple[str, int, int, int]]] = {}
+    # overload[peer] = {"kinds": {kind: count}, "witnesses": set}
+    overload: Dict[str, Dict[str, Any]] = {}
+
+    def _overload_hit(peer: str, kind: str, witness: str) -> None:
+        entry = overload.setdefault(
+            peer, {"kinds": {}, "witnesses": set()})
+        entry["kinds"][kind] = entry["kinds"].get(kind, 0) + 1
+        entry["witnesses"].add(witness)
+
     for j in journals:
         node = j.node
         per_index = commits.setdefault(node, {})
@@ -353,6 +388,8 @@ def audit(journals: List[Journal]) -> AuditResult:
                     ("fault", rec.kind, rec.node, node, inc, rec.seq),
                     f"era={rec.era} ep={rec.epoch} fault {rec.kind} "
                     f"by {rec.node} seen@{node}#{inc}"))
+                if rec.kind in _OVERLOAD_FAULT_KINDS:
+                    _overload_hit(rec.node, rec.kind, node)
             elif isinstance(rec, FlightSpan):
                 rnd = "-" if rec.round is None else rec.round
                 res.events.append(Event(
@@ -374,7 +411,24 @@ def audit(journals: List[Journal]) -> AuditResult:
                     else:
                         join.update({"node": node, "incarnation": inc})
                         res.sync_joins.append(join)
+                elif rec.kind == "guard":
+                    hit = _parse_guard_note(rec.detail)
+                    if hit is not None:
+                        _overload_hit(hit["peer"], hit["kind"], node)
     res.events.sort(key=lambda e: (e.era, e.epoch, e.rank, e.key))
+    # resource-exhaustion attribution: most-implicated peer first
+    res.overload_incidents = [
+        {
+            "peer": peer,
+            "kinds": dict(sorted(entry["kinds"].items())),
+            "witnesses": sorted(entry["witnesses"]),
+            "events": sum(entry["kinds"].values()),
+        }
+        for peer, entry in sorted(
+            overload.items(),
+            key=lambda kv: (-sum(kv[1]["kinds"].values()), kv[0]),
+        )
+    ]
 
     # -- digest-chain agreement ----------------------------------------------
     for node, per_index in commits.items():
@@ -555,6 +609,10 @@ def format_report(res: AuditResult, timeline: bool = False,
                else "boundary uncorroborated — no overlapping journal")
         lines.append(f"STATE-SYNC JOIN: {j['node']}#{j['incarnation']} "
                      f"activated at chain index {j['index']} ({how})")
+    for o in res.overload_incidents:
+        kinds = " ".join(f"{k}×{n}" for k, n in o["kinds"].items())
+        lines.append(f"OVERLOAD: peer {o['peer']} — {kinds} "
+                     f"(witnessed by {', '.join(o['witnesses'])})")
     for m in res.sync_mismatches:
         lines.append(f"SYNC MISMATCH: {m}")
     for m in res.status_mismatches:

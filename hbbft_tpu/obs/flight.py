@@ -285,17 +285,28 @@ class FlightRecorder:
             "hbbft_obs_flight_truncations_total",
             "journal segments deleted at digest-chain checkpoints "
             "(bounded storage; the chain head covers the history)")
+        self._c_prior_indexed = r.counter(
+            "hbbft_obs_flight_prior_segments_indexed_total",
+            "older-incarnation segments whose commit range was indexed "
+            "at startup so checkpoint truncation can reason about them "
+            "across restarts")
         self._g_segments = r.gauge(
             "hbbft_obs_flight_segments",
             "journal segment files currently retained on disk")
-        # highest commit-chain index each segment of THIS incarnation
-        # holds (checkpoint truncation can only reason about segments it
-        # watched being written; older incarnations' segments age out via
-        # the max_segments cap)
+        # highest commit-chain index per retained segment.  Segments of
+        # THIS incarnation are tracked as they rotate; segments left by
+        # OLDER incarnations are indexed once at startup (below) so the
+        # digest-chain checkpoint truncation can retire them too — an
+        # audit across restarts must not silently lose the incident
+        # window, and a restart must not pin stale segments forever.
+        # Older segments with no commits (or unreadable ones) stay
+        # unindexed and are KEPT: the max_segments cap remains their
+        # only bound, which errs on the side of preserving forensics.
         self._seg_commit_high: Dict[str, int] = {}
         self._cur_commit_high = -1
         os.makedirs(dirpath, exist_ok=True)
         self.incarnation = self._next_incarnation()
+        self._index_prior_segments()
         self._open_segment()
         self.note("restart" if self.incarnation > 1 else "start",
                   f"flavor={flavor}")
@@ -319,6 +330,30 @@ class FlightRecorder:
             if m:
                 out.append((int(m.group(1)), int(m.group(2)), name))
         return sorted(out)
+
+    def _index_prior_segments(self) -> None:
+        """Best-effort scan of older incarnations' on-disk segments for
+        their highest commit index (journal-spanning retention): each
+        indexed segment becomes eligible for checkpoint truncation once
+        the chain passes it.  The scan is lenient on purpose — a torn
+        tail still yields the commits before the tear (uncounted here:
+        the audit reader is the loud pass), and an unreadable segment
+        is simply kept."""
+        for _inc, _idx, name in self._segments():
+            try:
+                with open(os.path.join(self.dirpath, name), "rb") as fh:
+                    data = fh.read()
+            # hblint: disable=fault-swallowed-drop (nothing dropped: an
+            # unreadable prior segment stays on disk unindexed — kept,
+            # not lost; the audit reader surfaces the damage loudly)
+            except OSError:
+                continue
+            records, _torn = read_segment_bytes(data, count_torn=False)
+            high = max((r.index for r in records
+                        if isinstance(r, FlightCommit)), default=-1)
+            if high >= 0:
+                self._seg_commit_high[name] = high
+                self._c_prior_indexed.inc()
 
     def _open_segment(self) -> None:
         name = f"seg-{self.incarnation:04d}-{self._seg_idx:06d}.fjl"
@@ -434,11 +469,14 @@ class FlightRecorder:
         self.flush()  # a commit is the record worth surviving a crash
 
     def truncate_checkpoint(self, min_index: int) -> int:
-        """Bounded storage: delete rotated segments of this incarnation
-        whose every commit lies below digest-chain index ``min_index`` —
-        the checkpointed chain (head + ``/status``) covers them.  The
-        current segment is never deleted.  Returns how many segments
-        were removed (each counted)."""
+        """Bounded storage: delete rotated segments — of this
+        incarnation AND of older incarnations indexed at startup —
+        whose every commit lies below digest-chain index ``min_index``;
+        the checkpointed chain (head + ``/status``) covers them.
+        Older-incarnation segments that could not be indexed (no
+        commits, unreadable) are kept.  The current segment is never
+        deleted.  Returns how many segments were removed (each
+        counted)."""
         if min_index <= 0:
             return 0
         removed = 0
@@ -600,14 +638,17 @@ _c_torn = DEFAULT.counter(
     "(reader skipped the tail loudly)")
 
 
-def read_segment_bytes(data: bytes) -> Tuple[List[Any], bool]:
+def read_segment_bytes(data: bytes,
+                       count_torn: bool = True) -> Tuple[List[Any], bool]:
     """Parse one segment's bytes into records.
 
     Returns ``(records, torn)``: a mid-record truncation, CRC mismatch,
     or undecodable payload ends the segment — ``torn`` is True, the
     damage is counted (``hbbft_obs_flight_torn_tails_total``) and logged,
     and everything before the tear is returned.  Never raises on corrupt
-    input.
+    input.  ``count_torn=False`` skips the counter/log (the recorder's
+    lenient startup index pass re-reads segments the audit reader will
+    count loudly later — double-counting would fake journal damage).
     """
     records: List[Any] = []
     pos = 0
@@ -635,7 +676,7 @@ def read_segment_bytes(data: bytes) -> Tuple[List[Any], bool]:
             break  # torn: framing intact but payload undecodable
         pos += 8 + length
     torn = pos < n
-    if torn:
+    if torn and count_torn:
         _c_torn.inc()
         logger.warning(
             "flight: torn journal tail — %d trailing bytes skipped "

@@ -92,7 +92,7 @@ def render(targets: List[Target], prev: List[Optional[dict]],
     lines.append(
         f"{'node':<22} {'era':>4} {'epoch':>6} {'batch':>6} "
         f"{'ep/s':>6} {'mempool':>8} {'peers':>5} {'txs':>8} "
-        f"{'faults':>6} {'decode!':>7} {'gaps':>5} "
+        f"{'faults':>6} {'decode!':>7} {'gaps':>5} {'guard!':>6} "
         f"{'jrnl':>7} {'jseg':>4} {'jwf':>4}"
     )
     for i, (host, port) in enumerate(targets):
@@ -114,12 +114,21 @@ def render(targets: List[Target], prev: List[Optional[dict]],
         jrnl = fl.get("records", "-")
         jseg = fl.get("segments", "-")
         jwf = fl.get("write_failures", "-")
+        # overload-defense engagements: throttles + disconnects +
+        # backlog evictions + mempool sheds — nonzero means some peer
+        # or client is being actively budgeted (see /status "guard")
+        gd = d.get("guard") or {}
+        gi = gd.get("ingress") or {}
+        guard = (gi.get("throttles", 0) + gi.get("disconnects", 0)
+                 + gd.get("senderq_evictions", 0)
+                 + sum((gd.get("mempool_sheds") or {}).values()))
         lines.append(
             f"{name:<22} {d['era']:>4} {d['epoch']:>6} "
             f"{d['batches']:>6} {rate:>6} {d['mempool']:>8} "
             f"{d['peers_connected']:>5} {d['committed_txs']:>8} "
             f"{d['faults_observed']:>6} {d['decode_failures']:>7} "
-            f"{d['replay_gaps']:>5} {jrnl:>7} {jseg:>4} {jwf:>4}"
+            f"{d['replay_gaps']:>5} {guard:>6} "
+            f"{jrnl:>7} {jseg:>4} {jwf:>4}"
         )
     pq = phase_quantiles(cur)
     lines.append("")

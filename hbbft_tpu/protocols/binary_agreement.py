@@ -34,6 +34,14 @@ BoolSet = FrozenSet[bool]
 NONE: BoolSet = frozenset()
 BOTH: BoolSet = frozenset((True, False))
 
+#: legitimate distinct messages per future epoch per sender (2×BVal,
+#: 2×Aux, Conf, Coin, slack): the per-sender future-buffer cap is
+#: ``FUTURE_CAP_PER_EPOCH * (max_future_epochs + 1)`` — shared with the
+#: chaos campaign's guard witness so the asserted bound can never
+#: silently diverge from the enforced one
+FUTURE_CAP_PER_EPOCH = 8
+DEFAULT_MAX_FUTURE_EPOCHS = 16
+
 
 # -- messages (reference: binary_agreement message.rs) ----------------------
 
@@ -106,9 +114,12 @@ class SbvBroadcast:
         out: List[Tuple[str, bool]] = []
         count = len(self.bval_received[value])
         if count >= self.f + 1 and value not in self.bval_sent:
+            # hblint: disable=bounded-ingress (a set of BOOLS: the value
+            # domain caps it at two members)
             self.bval_sent.add(value)
             out.append(("bval", value))
         if count >= 2 * self.f + 1 and value not in self.bin_values:
+            # hblint: disable=bounded-ingress (same two-member bool set)
             self.bin_values.add(value)
             if not self.aux_sent:
                 self.aux_sent = True
@@ -148,7 +159,7 @@ class BinaryAgreement(ConsensusProtocol):
         netinfo: NetworkInfo,
         session_id: bytes,
         proposer_id: NodeId,
-        max_future_epochs: int = 16,
+        max_future_epochs: int = DEFAULT_MAX_FUTURE_EPOCHS,
     ):
         self.netinfo = netinfo
         self.session_id = bytes(session_id)
@@ -169,9 +180,21 @@ class BinaryAgreement(ConsensusProtocol):
         # future-epoch buffer: deduplicated, bounded per sender (≤ ~8
         # distinct messages per epoch are legitimate: 2×BVal, 2×Aux, Conf,
         # Coin, slack) so one Byzantine peer cannot grow memory unboundedly.
+        # Overflow is a counted EPOCH-PRIORITY eviction of the offending
+        # sender's own entries (never another peer's): the sender's
+        # farthest-future message goes first, because the lowest-epoch
+        # entries are the ones the protocol will need soonest.
         self.future: Set[Tuple[NodeId, object]] = set()
         self.max_future_epochs = max_future_epochs
-        self.future_cap_per_sender = 8 * (max_future_epochs + 1)
+        self.future_cap_per_sender = (
+            FUTURE_CAP_PER_EPOCH * (max_future_epochs + 1))
+        self.future_evictions: Dict[NodeId, int] = {}
+        # run-long high-water mark of any single sender's buffered
+        # entries, recorded BEFORE eviction — a working cap keeps this
+        # ≤ cap + 1 (the just-inserted entry), and a broken eviction
+        # shows up as a growing peak.  (A post-eviction reading would
+        # hold ≤ cap by construction and could never fail.)
+        self.future_peak = 0
 
     # -- ConsensusProtocol ---------------------------------------------------
 
@@ -204,14 +227,27 @@ class BinaryAgreement(ConsensusProtocol):
                 )
             entry = (sender_id, message)
             if entry not in self.future:
-                if (
-                    sum(1 for s, _ in self.future if s == sender_id)
-                    >= self.future_cap_per_sender
-                ):
+                self.future.add(entry)
+                mine = [e for e in self.future if e[0] == sender_id]
+                if len(mine) > self.future_peak:
+                    self.future_peak = len(mine)  # pre-evict, on purpose
+                if len(mine) > self.future_cap_per_sender:
+                    # counted epoch-priority eviction of the SPAMMER's
+                    # own farthest-future entry (which may be the one
+                    # just admitted) — deterministic victim choice so
+                    # the simulator's byte-identity replays hold
+                    victim = max(
+                        mine,
+                        key=lambda e: (getattr(e[1], "epoch", 0),
+                                       repr(e[1])),
+                    )
+                    self.future.discard(victim)
+                    self.future_evictions[sender_id] = (
+                        self.future_evictions.get(sender_id, 0) + 1
+                    )
                     return Step.from_fault(
                         sender_id, FaultKind.AgreementEpochMismatch
                     )
-                self.future.add(entry)
             return Step()
         return self._handle_current(sender_id, message)
 

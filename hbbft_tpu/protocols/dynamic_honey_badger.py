@@ -459,7 +459,15 @@ class DynamicHoneyBadger(ConsensusProtocol):
         self.kg_transcript: List[SignedKeyGenMsg] = []
         self.last_join_transcript: Tuple[SignedKeyGenMsg, ...] = ()
         self.vote_num = 0
+        # next-era message buffer — budgeted PER SENDER (overload
+        # defense): the old shared 100k cap let one Byzantine peer fill
+        # the whole buffer (uncounted) and starve honest next-era
+        # traffic.  Now each sender owns a slice; overflow drops ONLY
+        # that sender's messages, counted in ``future_era_drops``.
         self.future_era: List[Tuple[NodeId, object]] = []
+        self.future_era_cap_per_sender = 4096
+        self._future_era_counts: Dict[NodeId, int] = {}
+        self.future_era_drops: Dict[NodeId, int] = {}
         # what to propose when only the DKG needs the epoch to advance: a
         # wrapper (QueueingHoneyBadger) installs a provider that returns a
         # REAL contribution so throughput doesn't stall during a DKG
@@ -628,8 +636,18 @@ class DynamicHoneyBadger(ConsensusProtocol):
                     return Step.from_fault(
                         sender_id, FaultKind.UnexpectedHbMessage
                     )
-                if len(self.future_era) < 100_000:
-                    self.future_era.append((sender_id, message))
+                count = self._future_era_counts.get(sender_id, 0)
+                if count >= self.future_era_cap_per_sender:
+                    # counted drop of the SPAMMER's overflow only —
+                    # other senders' next-era slices are untouched
+                    self.future_era_drops[sender_id] = (
+                        self.future_era_drops.get(sender_id, 0) + 1
+                    )
+                    return Step.from_fault(
+                        sender_id, FaultKind.FutureEpochFlood
+                    )
+                self._future_era_counts[sender_id] = count + 1
+                self.future_era.append((sender_id, message))
                 return Step()
             inner = self.hb.handle_message(sender_id, message.msg)
             return self._process_hb_step(inner)
@@ -870,6 +888,7 @@ class DynamicHoneyBadger(ConsensusProtocol):
         step = Step()
         # replay buffered next-era messages
         future, self.future_era = self.future_era, []
+        self._future_era_counts.clear()
         for sender, msg in future:
             if msg.era == self.era:
                 step.extend(self.handle_message(sender, msg))

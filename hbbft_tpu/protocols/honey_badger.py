@@ -226,6 +226,27 @@ class HoneyBadger(ConsensusProtocol):
         # per iteration.  False (default) keeps the simulator-exact path.
         self.defer_decrypt = False
         self._deferred_decrypts: List[Tuple[int, NodeId, Any]] = []
+        # Per-sender future-epoch admission budget (overload defense):
+        # a Byzantine validator spamming protocol messages at the
+        # `epoch + max_future_epochs` window edge forces future epoch
+        # states open and churns their sub-protocols.  Honest pipelined
+        # traffic between two epoch advances is well under ~100 messages
+        # per sender per future epoch at any tested topology; beyond the
+        # budget the sender's messages for epochs ahead of the current
+        # one are dropped with a counted FutureEpochFlood fault.  Counts
+        # reset every time the current epoch advances (the window slid).
+        self.future_msg_budget = 256 * (max_future_epochs + 1)
+        self._future_counts: Dict[NodeId, int] = {}
+        self.future_drops: Dict[NodeId, int] = {}
+        # guard statistics folded from CLOSED epochs (their Subset/BA
+        # instances are deleted with the epoch state — without this a
+        # run-long "peak stayed ≤ cap" witness would silently lose
+        # every epoch that completed before it was read)
+        self.closed_guard: Dict[str, int] = {
+            "aba_future_peak": 0,
+            "aba_future_evictions": 0,
+            "subset_flood_drops": 0,
+        }
 
     @classmethod
     def builder(cls, netinfo: NetworkInfo) -> HoneyBadgerBuilder:
@@ -288,6 +309,15 @@ class HoneyBadger(ConsensusProtocol):
             return Step()  # obsolete epoch
         if epoch > self.epoch + self.max_future_epochs:
             return Step.from_fault(sender_id, FaultKind.UnexpectedHbMessage)
+        if epoch > self.epoch:
+            count = self._future_counts.get(sender_id, 0) + 1
+            if count > self.future_msg_budget:
+                self.future_drops[sender_id] = (
+                    self.future_drops.get(sender_id, 0) + 1
+                )
+                return Step.from_fault(sender_id,
+                                       FaultKind.FutureEpochFlood)
+            self._future_counts[sender_id] = count
         if isinstance(message, SubsetWrap):
             state = self._epoch_state(epoch)
             inner = state.subset.handle_message(sender_id, message.msg)
@@ -362,6 +392,18 @@ class HoneyBadger(ConsensusProtocol):
                 self._process_decrypt_step(epoch, proposer, inner)
             )
         return step
+
+    def _fold_guard(self, state: "_EpochState") -> None:
+        """Preserve a closing epoch's overload-guard statistics."""
+        g = self.closed_guard
+        g["subset_flood_drops"] += sum(
+            state.subset.flood_drops.values())
+        for prop in state.subset.proposals.values():
+            ba = prop.agreement
+            if ba.future_peak > g["aba_future_peak"]:
+                g["aba_future_peak"] = ba.future_peak
+            g["aba_future_evictions"] += sum(
+                ba.future_evictions.values())
 
     def _process_subset_step(self, epoch: int, inner: Step) -> Step:
         step = inner.map(lambda m: SubsetWrap(epoch, m))
@@ -492,10 +534,17 @@ class HoneyBadger(ConsensusProtocol):
         if epoch not in self.completed and state.decrypted_all():
             self.completed[epoch] = state.batch()
         step = Step()
+        advanced = False
         while self.epoch in self.completed:
             batch = self.completed.pop(self.epoch)
             step.output.append(batch)
+            self._fold_guard(self.epochs[self.epoch])
             del self.epochs[self.epoch]
             self.has_input.pop(self.epoch, None)  # bound per-epoch state
             self.epoch += 1
+            advanced = True
+        if advanced and self._future_counts:
+            # the future window slid: every sender's admission budget
+            # renews (state stays bounded by the validator set)
+            self._future_counts.clear()
         return step

@@ -54,7 +54,10 @@ class TransactionQueue:
                 self._set[tx] = 1
                 self._txs.append(tx)
 
-    def remove_multiple(self, txs) -> None:
+    def remove_multiple(self, txs) -> int:
+        """Drop ``txs`` from the queue; returns how many were present
+        (the overload guard's shed path needs to know whether a tx was
+        actually still queued)."""
         # accept a pre-built set: the QHB commit prunes N queues with the
         # same epoch batch, and rebuilding the drop set per queue is O(N²)
         # across the network (16.7M hashes per epoch at N=4096)
@@ -62,7 +65,8 @@ class TransactionQueue:
             bytes(t) for t in txs
         }
         if not drop:
-            return
+            return 0
+        before = len(self._txs)
         self._txs = [t for t in self._txs if t not in drop]
         # iterate the smaller side: a node's queue is usually far smaller
         # than the network-wide epoch batch
@@ -72,6 +76,7 @@ class TransactionQueue:
         else:
             for t in drop:
                 self._set.pop(t, None)
+        return before - len(self._txs)
 
     def choose(self, rng: random.Random, amount: int,
                exclude: Optional[set] = None) -> List[bytes]:
@@ -307,6 +312,13 @@ class QueueingHoneyBadger(ConsensusProtocol):
         return self._process(self.dhb.resolve_deferred())
 
     # -- internals -----------------------------------------------------------
+
+    def in_flight_txs(self) -> set:
+        """Txs riding a not-yet-committed proposal (sequential AND
+        pipelined — both record into ``_proposed``): a shed of one of
+        these cannot stop it committing, so the overload guard must not
+        tell the client otherwise."""
+        return {t for txs in self._proposed.values() for t in txs}
 
     def _maybe_propose(self, force: bool = False) -> Step:
         if not self.dhb.is_validator():

@@ -13,6 +13,7 @@ HoneyBadger uses era 0.  A message is deliverable to a peer once
 
 from __future__ import annotations
 
+import bisect
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Hashable, Iterable, List, Optional, Tuple
 
@@ -85,14 +86,38 @@ def _algo_window(algo: Any) -> int:
     return algo.max_future_epochs
 
 
+#: default per-peer backlog ceiling — several full epochs of traffic at
+#: any tested topology, far above what an honest laggard accumulates
+#: inside its delivery window
+DEFAULT_BUFFERED_CAP = 2048
+
+
 class SenderQueue(ConsensusProtocol):
     """Reference: ``src/sender_queue/mod.rs :: SenderQueue<D>``."""
 
-    def __init__(self, algo: Any):
+    def __init__(self, algo: Any, *,
+                 buffered_cap: int = DEFAULT_BUFFERED_CAP,
+                 on_evict: Optional[Callable[[NodeId, int], None]] = None):
         self.algo = algo
         self.peer_epochs: Dict[NodeId, EpochKey] = {}
-        # per-peer buffered (key, message)
+        # per-peer buffered (key, message) — HARD-CAPPED per peer: a
+        # voted-in joiner that never connects (or a peer wedged far
+        # behind its window) must not grow this without bound.  At the
+        # cap the backlog front-chops its OLDEST (lowest-epoch) entries,
+        # counted per peer: a peer that far behind recovers via snapshot
+        # state-sync, which lands it at the current era boundary where
+        # the RETAINED (newest) entries are exactly the deliverable ones.
         self.buffered: Dict[NodeId, List[Tuple[EpochKey, Any]]] = {}
+        self.buffered_cap = int(buffered_cap)
+        self.evictions: Dict[NodeId, int] = {}
+        self.on_evict = on_evict
+        # run-long high-water mark of any peer's backlog, recorded
+        # BEFORE the cap chops (so a broken chop shows up as a growing
+        # peak — a post-chop reading would hold ≤ cap by construction
+        # and could never fail).  A working cap keeps this ≤ cap + 1
+        # (the one just-inserted entry).  Plain int: samplers on other
+        # threads read it without racing the list mutations.
+        self.buffered_peak = 0
         self.last_announced: Optional[EpochKey] = None
         # _known_peers runs once per posted Step (hot path): cache the
         # sorted peer list, keyed on what can change it — a new peer in
@@ -149,6 +174,31 @@ class SenderQueue(ConsensusProtocol):
 
     # -- internals -----------------------------------------------------------
 
+    def _cap_backlog(self, peer: NodeId) -> None:
+        """Enforce the per-peer backlog ceiling: front-chop the lowest
+        (era, epoch) entries beyond ``buffered_cap``, counted.  Epoch
+        priority on purpose — the retained NEWEST entries are the ones a
+        state-sync'd joiner (activated at the current era boundary) can
+        actually use; entries that old were only reachable through a
+        full replay the peer has already lost.  Backlogs are kept
+        key-sorted at insertion (bisect in ``_post``; ``reinit_peer``
+        merges pre-sorted), so the chop is O(drop), not a re-sort per
+        buffered message once a peer pins at the cap."""
+        entries = self.buffered.get(peer)
+        if entries is None:
+            return
+        if len(entries) > self.buffered_peak:
+            self.buffered_peak = len(entries)    # pre-chop, on purpose
+        if len(entries) > self.buffered_cap:
+            drop = len(entries) - self.buffered_cap
+            del entries[:drop]
+            self.evictions[peer] = self.evictions.get(peer, 0) + drop
+            if self.on_evict is not None:
+                self.on_evict(peer, drop)
+
+    def buffered_len(self, peer: NodeId) -> int:
+        return len(self.buffered.get(peer, ()))
+
     def _deliverable(self, key: Optional[EpochKey], peer: NodeId) -> bool:
         if key is None:
             return True
@@ -203,6 +253,7 @@ class SenderQueue(ConsensusProtocol):
                 keep.append((mkey, msg))
         if keep:
             self.buffered[peer] = keep
+            self._cap_backlog(peer)
         # re-announce ourselves so the restarted peer learns our epoch and
         # can address us immediately
         cur = _algo_key(self.algo)
@@ -253,9 +304,14 @@ class SenderQueue(ConsensusProtocol):
                         ready = []
                     ready.append(peer)
                 else:
-                    self.buffered.setdefault(peer, []).append(
-                        (key, tm.message)
+                    # key-sorted insertion (stable within a key): keeps
+                    # the backlog in epoch order so the cap's front-chop
+                    # and the release paths never need a sort
+                    bisect.insort(
+                        self.buffered.setdefault(peer, []),
+                        (key, tm.message), key=lambda kv: kv[0],
                     )
+                    self._cap_backlog(peer)
             if ready is not None:
                 # ALWAYS an explicit node set — never Target.all(): the
                 # driver resolves all() against ITS OWN membership view

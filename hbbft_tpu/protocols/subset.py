@@ -105,6 +105,15 @@ class Subset(ConsensusProtocol):
             )
         self.done = False
         self.false_inputs_sent = False
+        # Per-sender message budget for this ONE ACS instance (overload
+        # defense): honest traffic per sender is a few messages per
+        # proposer for RBC plus ~6 per ABA round — even a long
+        # coin-fought ABA stays well under this.  Past the budget a
+        # sender's messages are dropped with a counted fault; the
+        # count state is bounded by the validator set.
+        self.msg_budget_per_sender = 4096 * max(1, netinfo.num_nodes())
+        self._msg_counts: Dict[NodeId, int] = {}
+        self.flood_drops: Dict[NodeId, int] = {}
 
     # -- ConsensusProtocol ---------------------------------------------------
 
@@ -123,6 +132,13 @@ class Subset(ConsensusProtocol):
     def handle_message(self, sender_id: NodeId, message) -> Step:
         if not self.netinfo.is_node_validator(sender_id):
             return Step.from_fault(sender_id, FaultKind.UnknownSender)
+        count = self._msg_counts.get(sender_id, 0) + 1
+        if count > self.msg_budget_per_sender:
+            self.flood_drops[sender_id] = (
+                self.flood_drops.get(sender_id, 0) + 1
+            )
+            return Step.from_fault(sender_id, FaultKind.SubsetMessageFlood)
+        self._msg_counts[sender_id] = count
         if isinstance(message, BroadcastWrap):
             prop = self.proposals.get(message.proposer_id)
             if prop is None:

@@ -454,6 +454,225 @@ class VoteStormAdversary(Adversary):
             self.injected += 1
 
 
+class FloodAdversary(Adversary):
+    """Max-rate valid-frame spam from one peer (overload defense drill).
+
+    Every message the flooder emits is amplified ``copies``-fold, and
+    each crank the flooder re-injects duplicates of its own in-flight
+    traffic — all of it VALID protocol messages, the flood shape a
+    budget guard cannot reject on content.  Correct nodes must keep
+    committing with every per-peer buffer pinned under its cap: the
+    protocols treat duplicates as no-ops, the queues absorb the burst,
+    and nothing grows without bound.  The injection budget is seeded so
+    the run terminates and replays byte-identically.
+    """
+
+    def __init__(self, flooder, seed: int = 0, copies: int = 3,
+                 budget: Optional[int] = None):
+        self.flooder = flooder
+        self.rng = random.Random(seed)
+        self.copies = copies
+        if budget is None:
+            budget = 2_000 + self.rng.randrange(0, 2_001)
+        self.budget = budget
+        self.injected = 0
+
+    def filter_message(self, net: "VirtualNet", msg: "NetworkMessage"):
+        if msg.sender == self.flooder and self.injected < self.budget:
+            for _ in range(self.copies):
+                if self.injected >= self.budget:
+                    break
+                net.queue.append(msg)
+                self.injected += 1
+        return msg
+
+    def pre_crank(self, net: "VirtualNet") -> None:
+        if self.injected >= self.budget:
+            return
+        mine = [m for m in net.queue if m.sender == self.flooder]
+        if mine:
+            net.queue.append(self.rng.choice(mine))
+            self.injected += 1
+
+
+class FutureEpochSpamAdversary(Adversary):
+    """Window-edge protocol spam: the spammer injects binary-agreement
+    messages addressed to epochs at ``hb.epoch + max_future_epochs`` —
+    the farthest epoch a correct node must still accept — with ABA
+    epochs fanned across the ABA future window, forcing the receivers'
+    future-epoch buffers toward their caps.
+
+    Correct nodes must keep committing, every BA ``future`` buffer must
+    stay ≤ ``future_cap_per_sender`` (overflow front-evicts the
+    spammer's own entries, counted), and HoneyBadger's per-sender
+    future-epoch budget must absorb the rest.  Deterministic per seed.
+    """
+
+    def __init__(self, spammer, seed: int = 0, per_wave: int = 40,
+                 budget: Optional[int] = None):
+        self.spammer = spammer
+        self.rng = random.Random(seed)
+        self.per_wave = per_wave
+        if budget is None:
+            # sized so EACH victim's share of the stream exceeds the
+            # HoneyBadger per-sender future-epoch budget (the drill must
+            # actually make the defense engage, not just approach it)
+            budget = 6_000 + self.rng.randrange(0, 3_001)
+        self.budget = budget
+        self.injected = 0
+
+    def pre_crank(self, net: "VirtualNet") -> None:
+        if self.injected >= self.budget or not net.queue:
+            return
+        from hbbft_tpu.protocols.binary_agreement import (
+            AuxMsg, BValMsg,
+        )
+        from hbbft_tpu.protocols.dynamic_honey_badger import HbWrap
+        from hbbft_tpu.protocols.honey_badger import SubsetWrap
+        from hbbft_tpu.protocols.sender_queue import AlgoMessage
+        from hbbft_tpu.protocols.subset import AgreementWrap
+        from hbbft_tpu.sim.virtual_net import NetworkMessage
+
+        correct = net.correct_ids()
+        probe = net.nodes[correct[0]].algorithm
+        algo = getattr(probe, "algo", probe)          # unwrap SenderQueue
+        sender_queued = algo is not probe
+        dhb = getattr(algo, "dhb", algo)
+        hb = getattr(dhb, "hb", dhb)
+        era = getattr(dhb, "era", 0)
+        edge = hb.epoch + hb.max_future_epochs        # window edge
+        proposers = sorted(net.node_ids(), key=repr)
+        for _ in range(self.per_wave):
+            if self.injected >= self.budget:
+                return
+            proposer = proposers[self.rng.randrange(len(proposers))]
+            aba_epoch = self.rng.randrange(1, 17)     # BA future window
+            cls = BValMsg if self.rng.random() < 0.5 else AuxMsg
+            inner = cls(aba_epoch, bool(self.rng.randrange(2)))
+            payload = HbWrap(era, SubsetWrap(
+                edge, AgreementWrap(proposer, inner)))
+            if sender_queued:
+                payload = AlgoMessage(payload)
+            # the same spam hits EVERY correct node, so each receiver's
+            # per-sender budget sees the full stream
+            for victim in correct:
+                if self.injected >= self.budget:
+                    return
+                net.queue.append(
+                    NetworkMessage(self.spammer, victim, payload))
+                self.injected += 1
+
+
+class GarbageStreamAdversary:
+    """Framing-valid, decode-invalid byte streams against a REAL node.
+
+    The socket-kind sibling of :class:`FloodAdversary`: it dials a live
+    node's port, completes a node-role hello under a CLAIMED validator
+    identity (the transport's documented trust boundary — identification,
+    not authentication), then streams MSG frames whose payloads are
+    seeded random bytes — every frame passes the length-prefix framing
+    layer, every payload fails ``wire.decode_message``.  The victim must
+    count each one (``decode_failures`` + guard decode strikes), keep
+    committing, and eventually disconnect the stream with a counted
+    backoff (``hbbft_guard_ingress_disconnects_total``), which this
+    driver observes as connection resets.
+
+    With ``valid_frames=True`` the payloads are instead well-formed
+    ``EpochStarted`` announcements — max-rate VALID-frame spam, the
+    socket realization of :class:`FloodAdversary`: the byte budget and
+    in-flight frame caps are then the only defense that can engage.
+    """
+
+    def __init__(self, seed: int = 0, budget_frames: int = 20_000,
+                 frame_bytes: int = 256, valid_frames: bool = False):
+        self.rng = random.Random(seed)
+        self.budget_frames = budget_frames
+        self.frame_bytes = frame_bytes
+        self.valid_frames = valid_frames
+        self.frames_sent = 0
+        self.bytes_sent = 0
+        # connection teardowns observed, INCLUDING hellos refused
+        # during the victim's guard backoff window (a refused hello
+        # surfaces as the socket closing before any reply — the
+        # victim-side hbbft_guard_hello_rejects_total counter is the
+        # authoritative per-cause ledger)
+        self.disconnects = 0
+
+    def _frame(self) -> bytes:
+        from hbbft_tpu.net import framing
+
+        if self.valid_frames:
+            if not hasattr(self, "_valid_frame"):
+                from hbbft_tpu.protocols import wire
+                from hbbft_tpu.protocols.sender_queue import EpochStarted
+
+                # one MSG_BATCH frame carrying hundreds of well-formed
+                # EpochStarted announcements: a single socket write
+                # floods the victim with valid frames faster than the
+                # write path alone ever could
+                enc = wire.encode_message(EpochStarted((0, 0)))
+                self._valid_frame = framing.pack_msgs(
+                    [enc] * 512, framing.DEFAULT_MAX_FRAME)[0]
+            return self._valid_frame
+        return framing.encode_frame(
+            framing.MSG,
+            bytes(self.rng.randrange(256)
+                  for _ in range(self.frame_bytes)),
+            framing.DEFAULT_MAX_FRAME)
+
+    async def run(self, addr, cluster_id: bytes, identity,
+                  duration_s: float = 10.0) -> None:
+        """Flood ``addr`` claiming ``identity`` until the frame budget
+        or ``duration_s`` runs out, reconnecting through disconnects."""
+        import asyncio
+        import time as _time
+
+        from hbbft_tpu.net import framing
+
+        deadline = _time.monotonic() + duration_s
+        while (self.frames_sent < self.budget_frames
+               and _time.monotonic() < deadline):
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(*addr), 2.0)
+            except (OSError, asyncio.TimeoutError):
+                await asyncio.sleep(0.05)
+                continue
+            try:
+                hello = framing.Hello(
+                    node_id=identity, role=framing.ROLE_NODE,
+                    cluster_id=bytes(cluster_id), era=0, epoch=0)
+                writer.write(framing.encode_frame(
+                    framing.HELLO, framing.encode_hello(hello),
+                    framing.DEFAULT_MAX_FRAME))
+                await writer.drain()
+                kind, _payload = await asyncio.wait_for(
+                    framing.read_one_frame(
+                        reader, framing.DEFAULT_MAX_FRAME), 2.0)
+                if kind != framing.HELLO:
+                    raise ConnectionError(
+                        f"unexpected reply kind {kind}")
+                while (self.frames_sent < self.budget_frames
+                       and _time.monotonic() < deadline):
+                    if writer.is_closing():
+                        raise ConnectionError("stream torn down")
+                    frame = self._frame()
+                    writer.write(frame)
+                    self.frames_sent += 1
+                    self.bytes_sent += len(frame)
+                    if self.frames_sent % 16 == 0:
+                        await asyncio.wait_for(writer.drain(), 5.0)
+            except (OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError, ConnectionError):
+                # the guard tore the stream down (or refused the hello
+                # during its backoff window): the defense engaging IS
+                # the observable — count it and press on
+                self.disconnects += 1
+                await asyncio.sleep(0.1)
+            finally:
+                writer.close()
+
+
 class CrashAtEpochAdversary(Adversary):
     """Crash-stop at epoch: once the victim node has produced
     ``after_batches`` outputs (committed batches for a QHB stack), ALL

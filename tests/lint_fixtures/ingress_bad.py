@@ -1,0 +1,15 @@
+"""bounded-ingress fixture: network-fed growth with no bounding."""
+
+
+class LeakyBuffer:
+    def __init__(self):
+        self.held = {}
+        self.log = []
+
+    def handle_message(self, sender_id, msg):
+        # grows a per-sender list from network input, never bounded
+        self.held.setdefault(sender_id, []).append(msg)
+
+    def on_frame(self, peer_id, payload):
+        # grows a flat list from network input, never bounded
+        self.log.append((peer_id, payload))

@@ -1,0 +1,28 @@
+"""bounded-ingress fixture: the same buffers, capped and evicted."""
+
+
+class BoundedBuffer:
+    CAP = 64
+
+    def __init__(self):
+        self.held = {}
+        self.log = []
+        self.evictions = 0
+        self.seen_peers = set()
+
+    def handle_message(self, sender_id, msg):
+        self.held.setdefault(sender_id, []).append(msg)
+        if len(self.held[sender_id]) > self.CAP:
+            self.held[sender_id].pop(0)   # counted front-chop at cap
+            self.evictions += 1
+
+    def on_frame(self, peer_id, payload):
+        self.log.append((peer_id, payload))
+        if len(self.log) > self.CAP:
+            del self.log[: len(self.log) - self.CAP]
+            self.evictions += 1
+
+    def note_peer(self, peer_id, payload):
+        # adding just the sender identity is bounded by peer
+        # cardinality — exempt without any cap
+        self.seen_peers.add(peer_id)

@@ -145,6 +145,80 @@ def test_socket_cell_pipelined_wan(tmp_path):
     assert detail["pipeline_depth"] == 2
 
 
+def test_future_spam_cell_bounded_counted_attributed(tmp_path):
+    """Overload defense, sim kind: window-edge protocol spam from the
+    faulty node — the victims keep committing, every future buffer
+    stays under its cap, the per-sender budgets count the flood, and
+    the audit attributes the overload to the spammer."""
+    spec = CellSpec(shape="none", adversary="future-spam", n=4, seed=0,
+                    crank_limit=60_000)
+    assert spec.faulty == (3,)
+    detail, res = run_cell(spec, str(tmp_path))
+    assert detail["verdict"] == "clean", res.as_dict()
+    assert detail["batches_min"] >= 1
+    g = detail["guard"]
+    if g["aba_future_cap"]:
+        # peaks record PRE-eviction (falsifiable witness): cap + the
+        # one just-inserted entry is the legal ceiling
+        assert g["aba_future_peak"] <= g["aba_future_cap"] + 1
+    assert g["hb_future_drops"] > 0
+    assert detail["overload_attributed_to"] == ["3"]
+    assert res.overload_incidents[0]["kinds"]["FutureEpochFlood"] > 0
+
+
+def test_flood_cell_keeps_committing(tmp_path):
+    """Sim kind: max-rate valid-frame spam amplification — duplicates
+    are protocol no-ops, the queues absorb the burst, liveness holds."""
+    spec = CellSpec(shape="none", adversary="flood", n=4, seed=0,
+                    crank_limit=60_000)
+    detail, res = run_cell(spec, str(tmp_path))
+    assert detail["verdict"] == "clean", res.as_dict()
+    assert detail["batches_min"] >= 1
+
+
+def test_socket_garbage_stream_cell(tmp_path):
+    """Overload defense, socket kind: a raw-socket injector claiming
+    validator 3's identity streams framing-valid decode-invalid bytes
+    at node 0.  The cluster keeps committing, the guard counts every
+    strike and disconnects the stream with backoff, the live-sampled
+    buffer gauges stay under their caps, and the audit attributes the
+    incident to the claimed peer."""
+    from hbbft_tpu.chaos.campaign import run_socket_cell
+
+    detail, res = run_socket_cell(
+        CellSpec(kind="socket", shape="none", adversary="garbage-stream",
+                 n=4, seed=0, pipeline_depth=2), str(tmp_path))
+    assert detail["verdict"] == "clean", res.as_dict()
+    assert detail["batches_min"] >= 1
+    g = detail["guard"]
+    assert g["decode_strikes"] > 0
+    assert g["disconnects"] >= 1
+    assert g["injector"]["frames_sent"] > 0
+    peaks, caps = g["gauge_peaks"], g["gauge_caps"]
+    assert peaks["senderq_buffered"] <= caps["senderq_buffered"]
+    assert peaks["inflight_frames"] <= caps["inflight_frames"]
+    assert "3" in detail["overload_attributed_to"]
+
+
+@pytest.mark.slow
+def test_socket_valid_frame_flood_cell(tmp_path):
+    """Socket kind, valid-frame flood: MSG_BATCH frames of well-formed
+    EpochStarted spam — only the byte budget and in-flight caps can
+    engage, and they must (counted throttles, then a disconnect)."""
+    from hbbft_tpu.chaos.campaign import run_socket_cell
+
+    detail, res = run_socket_cell(
+        CellSpec(kind="socket", shape="none", adversary="flood",
+                 n=4, seed=0, pipeline_depth=2), str(tmp_path))
+    assert detail["verdict"] == "clean", res.as_dict()
+    assert detail["batches_min"] >= 1
+    g = detail["guard"]
+    assert g["throttles"] > 0 or g["disconnects"] >= 1
+    assert g["gauge_peaks"]["inflight_frames"] <= \
+        g["gauge_caps"]["inflight_frames"]
+    assert "3" in detail["overload_attributed_to"]
+
+
 def test_campaign_cli_smoke(tmp_path):
     out = tmp_path / "report.json"
     rc = campaign_main(["--grid", "smoke", "--max-cells", "2",

@@ -88,6 +88,17 @@ def test_fault_accounting_fixture():
                  "hbbft_tpu/net/fault_good.py") == []
 
 
+def test_bounded_ingress_fixture():
+    from hbbft_tpu.lint.bounded_ingress import BoundedIngressChecker
+
+    rules = fired(BoundedIngressChecker(), "ingress_bad.py")
+    # both growth sites fire: the per-sender setdefault().append and
+    # the flat log.append
+    assert rules == ["bounded-ingress", "bounded-ingress"]
+    # capped + counted (or sender-identity-valued) growth stays quiet
+    assert fired(BoundedIngressChecker(), "ingress_good.py") == []
+
+
 def test_wire_ast_fixture():
     chk = WireCompletenessChecker()
     bad = ModuleSource(FIXTURES, "wire_bad.py")
@@ -342,10 +353,10 @@ def test_lint_repo_clean():
     assert doc["findings"] == [], doc["findings"]
     assert doc["summary"]["clean"] is True
     assert doc["summary"]["baselined"] <= 10
-    # all five checkers ran
+    # all six checkers ran
     assert set(doc["checkers"]) == {
         "determinism", "asyncio-hazard", "wire-completeness",
-        "fault-accounting", "metric-convention",
+        "fault-accounting", "metric-convention", "bounded-ingress",
     }
 
 
@@ -354,7 +365,7 @@ def test_lint_cli_list_rules():
     assert proc.returncode == 0
     for rule in ("det-wall-clock", "async-fire-and-forget-task",
                  "wire-not-hashable", "fault-except-pass",
-                 "metric-convention"):
+                 "metric-convention", "bounded-ingress"):
         assert rule in proc.stdout
 
 

@@ -304,3 +304,112 @@ def test_same_seed_same_schedule_and_batches():
             assert set(flat) == set(TXS)
 
     asyncio.run(asyncio.wait_for(scenario(), 300))
+
+
+# ---------------------------------------------------------------------------
+# multi-process --join (the PR-8 membership lifecycle as an OS process)
+
+
+def test_join_cli_parses_and_builds_command():
+    """Tier-1 wiring check: ``--join`` relaxes the node-id range and the
+    command builder emits the full flag set."""
+    from hbbft_tpu.net.cluster import join_command, main as cluster_main
+
+    cfg = ClusterConfig(n=4, seed=7, base_port=25000, batch_size=4)
+    cmd = join_command(cfg, 4)
+    assert "--join" in cmd and "--node-id" in cmd
+    assert cmd[cmd.index("--node-id") + 1] == "4"
+    # without --join, an out-of-range node id is still an argparse error
+    with pytest.raises(SystemExit):
+        cluster_main(["--nodes", "4", "--node-id", "4",
+                      "--base-port", "25000"])
+
+
+def test_join_cli_runs_the_join_flow(monkeypatch):
+    """``--join`` routes main() into run_join_node (not run_node)."""
+    import hbbft_tpu.net.cluster as cluster_mod
+
+    called = {}
+
+    def fake_run(coro):
+        called["coro"] = coro.cr_code.co_name
+        coro.close()
+
+    monkeypatch.setattr(cluster_mod.asyncio, "run", fake_run)
+    cluster_mod.main(["--nodes", "4", "--node-id", "5",
+                      "--base-port", "25000", "--join"])
+    assert called["coro"] == "run_join_node"
+    cluster_mod.main(["--nodes", "4", "--node-id", "0",
+                      "--base-port", "25000"])
+    assert called["coro"] == "run_node"
+
+
+def test_join_cli_process_joins_live_cluster(tmp_path):
+    """The full multi-process --join flow: an in-process 4-node cluster
+    votes node 4 in (DKG rotation), then a FRESH OS PROCESS runs
+    ``python -m hbbft_tpu.net.cluster --join --node-id 4`` — it
+    state-syncs the era-boundary snapshot from the live donors,
+    activates share-complete, and commits with the cluster."""
+    cfg = ClusterConfig(n=4, seed=29, batch_size=4,
+                        base_port=find_free_base_port(6),
+                        heartbeat_s=0.3, dead_after_s=2.0,
+                        flight_dir=str(tmp_path / "flight"))
+    cluster = LocalCluster(cfg)
+    proc = None
+
+    async def scenario():
+        nonlocal proc
+        await cluster.start()
+        try:
+            await _join_body()
+        finally:
+            await cluster.stop()
+
+    async def _join_body():
+        nonlocal proc
+        client = await cluster.client(0)
+        for i in range(8):
+            assert await client.submit(b"pre-%02d" % i) == 0
+        # vote node 4 in and wait for every donor to serve the
+        # era-boundary snapshot of the completed rotation
+        cluster.vote_to_add(4)
+        min_era = max(rt.current_key()[0] for rt in cluster.runtimes) + 1
+        await cluster.wait_snapshot(min_era, timeout_s=120)
+        proc = spawn_node(cfg, 4, join=True,
+                          stdout=subprocess.DEVNULL,
+                          stderr=subprocess.STDOUT)
+        # keep traffic flowing while the joiner boots + state-syncs
+        deadline = time.monotonic() + 180
+        wave = 0
+        joined_doc = None
+        while time.monotonic() < deadline:
+            txs = [b"post-%02d-%02d" % (wave, i) for i in range(4)]
+            wave += 1
+            for tx in txs:
+                await client.submit(tx)
+            for tx in txs:
+                await client.wait_committed(tx, timeout_s=120)
+            assert proc.poll() is None, "joiner process died"
+            try:
+                jc = ClusterClient(cfg.addr(4), cfg.cluster_id,
+                                   client_id="probe")
+                await jc.connect()
+                doc = await jc.status()
+                await jc.close()
+                if doc["batches"] >= 1:
+                    joined_doc = doc
+                    break
+            except (OSError, asyncio.TimeoutError, ConnectionError):
+                await asyncio.sleep(0.5)
+        assert joined_doc is not None, "joiner never committed a batch"
+        assert joined_doc["era"] >= min_era
+        # the joiner's chain must agree with a donor's wherever the
+        # retained tails overlap
+        d0 = await client.status()
+        assert_status_chains_consistent([d0, joined_doc])
+
+    try:
+        asyncio.run(asyncio.wait_for(scenario(), 300))
+    finally:
+        if proc is not None:
+            shutdown_procs([proc])

@@ -1,0 +1,583 @@
+"""Byzantine overload defense: ingress budgets, backlog caps, shedding.
+
+The tentpole's acceptance surface, unit-level: every network-fed buffer
+is budgeted, evictable, and observable —
+
+- :class:`~hbbft_tpu.net.transport.IngressBudget` token bucket,
+  in-flight frame cap, strike ladder and disconnect backoff;
+- wire-decode fuzz under a sustained garbage-frame flood (counted,
+  bounded state, guard strikes escalate to a disconnect);
+- SenderQueue backlog front-chop at the per-peer cap, counted, with the
+  statesync-shaped catch-up still working from the retained tail;
+- BinaryAgreement future-buffer eviction (the spammer's own entries,
+  epoch priority) and the HoneyBadger / DHB per-sender flood budgets;
+- mempool fair admission: a hog cannot starve an under-share client;
+- the forensic auditor attributing an overload incident to the
+  offending peer from journaled guard events.
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu.fault_log import FaultKind
+from hbbft_tpu.net.client import Mempool
+from hbbft_tpu.net.transport import IngressBudget
+from hbbft_tpu.obs.metrics import Registry
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# IngressBudget
+
+
+def test_token_bucket_throttles_then_recovers():
+    clock = FakeClock()
+    b = IngressBudget(Registry(), bytes_per_s=1000, burst_bytes=1000,
+                      throttle_strikes=1000, clock=clock)
+    assert b.charge("p", 600) == 0.0
+    delay = b.charge("p", 600)          # burst exhausted → pause
+    assert delay > 0
+    assert int(b._c_throttles.total()) == 1
+    assert float(b._c_throttle_s.total()) == pytest.approx(delay)
+    clock.t += 5.0                       # bucket refills
+    assert b.charge("p", 600) == 0.0
+    assert not b.kill_pending("p")
+
+
+def test_strike_ladder_disconnects_with_exponential_backoff():
+    clock = FakeClock()
+    events = []
+    b = IngressBudget(Registry(), bytes_per_s=100, burst_bytes=100,
+                      throttle_strikes=3, backoff_s=2.0, clock=clock)
+    b.on_event = lambda kind, peer, detail: events.append((kind, peer))
+    for _ in range(10):
+        b.charge("p", 500)
+        if b.kill_pending("p"):
+            break
+    else:
+        pytest.fail("strike ladder never tripped")
+    assert int(b._c_disconnects.total()) == 1
+    assert ("disconnect", "p") in events
+    # backoff window armed: hellos rejected until it expires
+    assert b.in_backoff("p")
+    assert int(b._c_hello_rejects.total()) == 1
+    clock.t += 2.1
+    assert not b.in_backoff("p")
+    # a second trip doubles the backoff
+    for _ in range(10):
+        b.charge("p", 500)
+        if b.kill_pending("p"):
+            break
+    assert b.in_backoff("p")
+    clock.t += 2.1                       # 2s window would have expired
+    assert b.in_backoff("p")             # but this one is 4s
+    clock.t += 2.0
+    assert not b.in_backoff("p")
+    # an unrelated peer is never affected
+    assert not b.in_backoff("q")
+
+
+def test_backlog_aftershocks_do_not_inflate_backoff_or_kill_successor():
+    """After a disconnect, the pump keeps draining frames the OLD
+    connection already admitted — those aftershock strikes must not
+    re-count the incident, double the backoff, or leave a stale kill
+    mark that tears down the peer's next legitimate connection."""
+    clock = FakeClock()
+    b = IngressBudget(Registry(), decode_strikes=4, backoff_s=2.0,
+                      clock=clock)
+    for _ in range(4):
+        b.decode_strike("p")
+    assert b.kill_pending("p")            # the recv loop tears down
+    assert int(b._c_disconnects.total()) == 1
+    # the pump drains the backlog: 8 more garbage frames = 2 more trips
+    for _ in range(8):
+        b.decode_strike("p")
+    assert int(b._c_disconnects.total()) == 1   # not re-counted
+    clock.t += 2.1                        # window (still 2 s) expires
+    assert not b.in_backoff("p")
+    # the honest owner of the identity reconnects: hello accept clears
+    # the stale kill mark, so its first chunk is NOT torn down
+    b.connection_accepted("p")
+    assert not b.kill_pending("p")
+    assert b.charge("p", 100) == 0.0
+
+
+def test_decode_strikes_trip_disconnect():
+    b = IngressBudget(Registry(), decode_strikes=4, clock=FakeClock())
+    for _ in range(3):
+        b.decode_strike("p")
+    assert not b.kill_pending("p")
+    b.decode_strike("p")
+    assert b.kill_pending("p")
+    assert int(b._c_decode_strikes.total()) == 4
+    assert int(b._c_disconnects.total()) == 1
+
+
+def test_inflight_cap_counts_and_retires():
+    clock = FakeClock()
+    b = IngressBudget(Registry(), bytes_per_s=1e9, burst_bytes=1e9,
+                      max_inflight_frames=4, throttle_strikes=1000,
+                      clock=clock)
+    b.track_inflight = True
+    for _ in range(6):
+        b.frame_admitted("p")
+    assert b.peer_doc()["'p'"]["inflight"] == 6
+    assert b.charge("p", 1) > 0          # over the in-flight cap
+    for _ in range(6):
+        b.frame_done("p")
+    assert b.peer_doc()["'p'"]["inflight"] == 0
+    clock.t += 1.0
+    assert b.charge("p", 1) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Runtime decode-fuzz flood (framing-valid, decode-invalid)
+
+
+@pytest.fixture(scope="module")
+def guard_runtime(request):
+    """One NodeRuntime (no sockets started) for the fuzz tests."""
+    from hbbft_tpu.net.cluster import ClusterConfig, build_algo, \
+        generate_infos
+
+    cfg = ClusterConfig(n=4, seed=3)
+    infos = generate_infos(cfg)
+    from hbbft_tpu.net.runtime import NodeRuntime
+
+    rt = NodeRuntime(build_algo(cfg, infos, 0), cfg.cluster_id,
+                     ingress_kwargs={"decode_strikes": 256})
+    return rt
+
+
+def test_decode_fuzz_flood_is_counted_and_bounded(guard_runtime):
+    """A sustained garbage-frame flood: every frame counted, the decode
+    memo stays bounded, no protocol state grows, and the guard's strike
+    ladder marks the peer for disconnect."""
+    rt = guard_runtime
+    rng = random.Random(0xF100D)
+    n_frames = 600
+    for i in range(n_frames):
+        kind = i % 3
+        if kind == 0:                     # undecodable bytes
+            payload = bytes(rng.randrange(256)
+                            for _ in range(rng.randrange(1, 128)))
+        elif kind == 1:                   # torn/empty frames
+            payload = b""
+        else:                             # decodes, protocol-rejected
+            from hbbft_tpu.protocols import wire
+            from hbbft_tpu.protocols.broadcast import ReadyMsg
+
+            payload = wire.encode_message(ReadyMsg(bytes(32)))
+        rt._process_peer_message(1, payload)
+    assert rt.decode_failures == n_frames
+    assert int(rt.transport.ingress._c_decode_strikes.total()) == n_frames
+    # bounded state: the decode memo only caches SUCCESSFUL decodes and
+    # clears wholesale at its cap; garbage must not accumulate anywhere
+    assert len(rt._decode_cache) <= 4096
+    assert rt.sq.buffered == {}
+    assert rt._replay == {}
+    # 600 garbage frames > the 256-strike ladder: the recv loop would
+    # tear this connection down on its next chunk
+    assert rt.transport.ingress.kill_pending(1)
+    assert int(rt.transport.ingress._c_disconnects.total()) >= 1
+
+
+def test_guard_state_visible_in_status(guard_runtime):
+    doc = guard_runtime.status_doc()
+    g = doc["guard"]
+    assert g["ingress"]["decode_strikes"] >= 600
+    assert g["ingress"]["disconnects"] >= 1
+    assert "senderq_evictions" in g and "mempool_sheds" in g
+
+
+# ---------------------------------------------------------------------------
+# SenderQueue backlog cap (the voted-in joiner that never connects)
+
+
+def test_senderq_backlog_front_chops_at_cap_and_catches_up(
+        shared_netinfo):
+    """PR-8's named gap: a voted-in joiner that never connects must not
+    grow the SenderQueue backlog without bound.  The backlog front-chops
+    its lowest-epoch entries at the cap (counted), and a later
+    state-sync-shaped announcement (the joiner landing at the current
+    key) still releases the retained deliverable tail in order."""
+    from hbbft_tpu.protocols.honey_badger import HoneyBadger, SubsetWrap
+    from hbbft_tpu.protocols.sender_queue import AlgoMessage, SenderQueue
+    from hbbft_tpu.traits import Step, Target
+
+    infos = shared_netinfo(4, 11)
+    evicted = []
+    sq = SenderQueue(HoneyBadger(infos[0], session_id=b"cap"),
+                     buffered_cap=8,
+                     on_evict=lambda peer, n: evicted.append((peer, n)))
+    # peer 3 never announces: its record stays (0, 0), window 3 — every
+    # message beyond epoch 3 buffers
+    for epoch in range(10, 40):
+        inner = Step()
+        inner.send(Target.nodes([3]), SubsetWrap(epoch, b"m%d" % epoch))
+        sq._post(inner)
+    assert sq.buffered_len(3) == 8                # pinned at the cap
+    assert sq.evictions[3] == 30 - 8
+    assert sum(n for _p, n in evicted) == 30 - 8
+    kept = sorted(k for k, _m in sq.buffered[3])
+    assert kept == [(0, e) for e in range(32, 40)]  # newest retained
+    # other peers' backlogs are untouched by 3's overflow
+    assert sq.buffered_len(0) == 0
+    # statesync catch-up shape: the joiner activates at the current era
+    # boundary and announces a key near the head — the retained tail is
+    # exactly the deliverable window
+    step = sq._peer_advanced(3, (0, 36))
+    released = [
+        tm for tm in step.messages
+        if isinstance(tm.message, AlgoMessage)
+    ]
+    assert released, "retained backlog must flow after the announcement"
+    assert sq.buffered_len(3) <= 8
+
+
+# ---------------------------------------------------------------------------
+# Protocol-layer flood budgets
+
+
+def test_ba_future_eviction_is_per_sender_epoch_priority(shared_netinfo):
+    from dataclasses import dataclass
+
+    from hbbft_tpu.protocols.binary_agreement import BinaryAgreement
+
+    @dataclass(frozen=True)
+    class FakeFutureMsg:                  # buffered on .epoch alone
+        epoch: int
+        nonce: int
+
+    infos = shared_netinfo(4, 11)
+    ba = BinaryAgreement(infos[0], b"s", 0)
+    cap = ba.future_cap_per_sender
+    # sender 1 spams far more distinct future messages than the cap
+    for i in range(cap + 30):
+        step = ba.handle_message(1, FakeFutureMsg(1 + i % 16, i))
+        del step
+    mine = [m for s, m in ba.future if s == 1]
+    assert len(mine) == cap               # pinned at the cap
+    assert ba.future_evictions[1] == 30
+    # epoch priority: the retained set skews to the LOWEST epochs
+    assert max(m.epoch for m in mine) <= 16
+    # an honest peer's few future messages are never evicted
+    ba.handle_message(2, FakeFutureMsg(2, 99_999))
+    assert sum(1 for s, _m in ba.future if s == 2) == 1
+    assert 2 not in ba.future_evictions
+
+
+def test_hb_future_epoch_budget_faults_and_resets(shared_netinfo):
+    from hbbft_tpu.protocols.binary_agreement import BValMsg
+    from hbbft_tpu.protocols.honey_badger import HoneyBadger, SubsetWrap
+    from hbbft_tpu.protocols.subset import AgreementWrap
+
+    infos = shared_netinfo(4, 11)
+    hb = HoneyBadger(infos[0], session_id=b"budget")
+    hb.future_msg_budget = 5
+    msg = SubsetWrap(2, AgreementWrap(0, BValMsg(1, True)))
+    for _ in range(5):
+        step = hb.handle_message(1, msg)
+        assert not step.fault_log
+    step = hb.handle_message(1, msg)
+    assert [f.kind for f in step.fault_log] == [FaultKind.FutureEpochFlood]
+    assert hb.future_drops[1] == 1
+    # another sender has its own budget
+    assert not hb.handle_message(2, msg).fault_log
+
+
+def test_dhb_future_era_cap_is_per_sender(shared_netinfo):
+    from hbbft_tpu.protocols.dynamic_honey_badger import (
+        DynamicHoneyBadger, HbWrap,
+    )
+    from hbbft_tpu.protocols.honey_badger import SubsetWrap
+
+    infos = shared_netinfo(4, 11)
+    dhb = DynamicHoneyBadger(infos[0], infos[0].secret_key(),
+                             rng=random.Random(5))
+    dhb.future_era_cap_per_sender = 5
+    msg = HbWrap(1, SubsetWrap(0, b"x"))
+    for _ in range(5):
+        assert not dhb.handle_message(1, msg).fault_log
+    step = dhb.handle_message(1, msg)
+    assert [f.kind for f in step.fault_log] == [FaultKind.FutureEpochFlood]
+    assert dhb.future_era_drops[1] == 1
+    assert len(dhb.future_era) == 5
+    # sender 2's slice is untouched by 1's overflow
+    assert not dhb.handle_message(2, msg).fault_log
+    assert len(dhb.future_era) == 6
+
+
+def test_subset_per_sender_message_budget(shared_netinfo):
+    from hbbft_tpu.protocols.binary_agreement import BValMsg
+    from hbbft_tpu.protocols.subset import AgreementWrap, Subset
+
+    infos = shared_netinfo(4, 11)
+    sub = Subset(infos[0], b"flood")
+    sub.msg_budget_per_sender = 3
+    msg = AgreementWrap(0, BValMsg(1, True))
+    for _ in range(3):
+        sub.handle_message(1, msg)
+    step = sub.handle_message(1, msg)
+    assert [f.kind for f in step.fault_log] == [
+        FaultKind.SubsetMessageFlood]
+    assert sub.flood_drops[1] == 1
+
+
+# ---------------------------------------------------------------------------
+# Mempool fair admission
+
+
+def test_mempool_hog_cannot_starve_light_client():
+    mp = Mempool(capacity=10)
+    for i in range(10):
+        assert mp.add(b"hog-%02d" % i, client_id="hog") == Mempool.ACCEPTED
+    # pool FULL — but the light client is under its fair share, so the
+    # hog's OLDEST pending tx is shed (counted) and the newcomer admitted
+    assert mp.add(b"light-0", client_id="light") == Mempool.ACCEPTED
+    assert mp.sheds == {"hog": 1}
+    assert len(mp) == 10
+    # the hog itself stays FULL: it is at/over its share
+    assert mp.add(b"hog-extra", client_id="hog") == Mempool.FULL
+    # fair share at 2 clients is 5: light keeps landing until it
+    # reaches it, each admission shedding one of the hog's
+    for i in range(1, 5):
+        assert mp.add(b"light-%d" % i,
+                      client_id="light") == Mempool.ACCEPTED
+    assert mp.sheds == {"hog": 5}
+    assert mp.add(b"light-5", client_id="light") == Mempool.FULL
+
+
+def test_mempool_sybil_swarm_cannot_grind_honest_client_to_zero():
+    """Client ids are self-declared: a swarm of minted ids must not
+    shrink the fair share toward zero and evict an honest bulk
+    client's whole allocation — the divisor is clamped."""
+    mp = Mempool(capacity=64)
+    mp.fair_clients_max = 4                   # share floor = 16
+    for i in range(64):
+        assert mp.add(b"bulk-%03d" % i,
+                      client_id="bulk") == Mempool.ACCEPTED
+    for i in range(200):                      # 200 fresh sybil ids
+        mp.add(b"sybil-%03d" % i, client_id="sybil-%03d" % i)
+    floor = mp.capacity // mp.fair_clients_max
+    assert mp._client_counts["bulk"] >= floor
+    assert sum(mp.sheds.values()) <= 64 - floor
+
+
+def test_mempool_byte_hog_is_sheddable_too():
+    """Fair share is count AND bytes: a client that filled the byte
+    ceiling with a few huge txs must not be unsheddable just because
+    its entry count is tiny."""
+    mp = Mempool(capacity=10_000, max_pending_bytes=10_000,
+                 max_tx_bytes=4_000)
+    for i in range(3):
+        assert mp.add(bytes([i]) * 3_000,    # 9 000 B in 3 txs
+                      client_id="bytehog") == Mempool.ACCEPTED
+    small = b"s" * 2_000
+    # byte-FULL; the hog's count (3) is far under the count share, but
+    # its bytes are over the byte share — one shed admits the newcomer
+    assert mp.add(small, client_id="light") == Mempool.ACCEPTED
+    assert sum(mp.sheds.values()) == 1
+    assert mp._client_counts["bytehog"] == 2
+
+
+def test_mempool_shed_is_feasibility_checked():
+    """Shedding never destroys acked state for a FULL anyway: if one
+    shed cannot admit the newcomer (byte pressure vs small victims),
+    nothing is shed at all."""
+    mp = Mempool(capacity=50, max_pending_bytes=1000)
+    for i in range(50):
+        assert mp.add(b"%020d" % i,          # 20 B each, 1000 B total
+                      client_id="hog") == Mempool.ACCEPTED
+    big = b"x" * 500                          # can never fit via 1 shed
+    assert mp.add(big, client_id="light") == Mempool.FULL
+    assert mp.sheds == {}                     # nothing destroyed
+    assert len(mp) == 50
+    small = b"y" * 20                         # one shed admits this
+    assert mp.add(small, client_id="light") == Mempool.ACCEPTED
+    assert sum(mp.sheds.values()) == 1
+
+
+def test_mempool_single_client_full_is_unchanged():
+    mp = Mempool(capacity=4)
+    for i in range(4):
+        assert mp.add(b"t%d" % i) == Mempool.ACCEPTED
+    assert mp.add(b"t4") == Mempool.FULL        # nobody to shed from
+    assert mp.sheds == {}
+    # committing frees space and the owner bookkeeping follows
+    mp.mark_committed([b"t0", b"t1"])
+    assert mp.add(b"t4") == Mempool.ACCEPTED
+    assert len(mp) == 3
+
+
+def test_mempool_shed_reaches_protocol_queue(guard_runtime):
+    """A shed tx was already handed to the consensus layer at
+    admission: shedding must pull it back out of the protocol queue
+    too, or rotating client identities could grow the queue without
+    bound through the shedding path itself."""
+    rt = guard_runtime
+    rt.mempool.capacity = 6
+    queue = rt.sq.algo.queue
+    hog_txs = [b"hog-q-%02d" % i for i in range(6)]
+    for tx in hog_txs:
+        assert rt.mempool.add(tx, client_id="hog") == Mempool.ACCEPTED
+        rt.pump.enqueue("input", rt.make_tx_input(tx))
+    # drain the pump events synchronously (no loop running in this
+    # test): the inputs land in the protocol queue
+    events = [("input", (rt.make_tx_input(tx),)) for tx in hog_txs]
+    rt.pump_process(events, depth=1)
+    before = len(queue)
+    assert before >= len(hog_txs)
+    assert rt.mempool.add(b"light-q", client_id="light") \
+        == Mempool.ACCEPTED
+    # the shed hook enqueued a pump event; process it
+    shed_events = []
+    while rt.pump._inbox:
+        shed_events.append(rt.pump._inbox.popleft())
+    assert any(k == "shed" for k, _a in shed_events)
+    rt.pump_process([e for e in shed_events if e[0] == "shed"], depth=1)
+    assert len(queue) == before - 1
+    assert hog_txs[0] not in queue._set
+
+
+def test_mempool_sheds_dict_is_key_capped():
+    mp = Mempool(capacity=4)
+    mp._sheds_key_cap = 2
+    # rotate hog identities (commit everything between waves so each
+    # wave's hog really fills the pool); every shed victim would
+    # otherwise mint a fresh dict key forever
+    for wave in range(6):
+        hog = "hog-%d" % wave
+        for i in range(4):
+            assert mp.add(b"h%d-%d" % (wave, i),
+                          client_id=hog) == Mempool.ACCEPTED
+        assert mp.add(b"l%d" % wave,
+                      client_id="light-%d" % wave) == Mempool.ACCEPTED
+        mp.mark_committed(list(mp._pending.values()))
+    assert sum(mp.sheds.values()) == 6
+    assert len(mp.sheds) <= 3                  # 2 keys + _overflow_
+    assert "_overflow_" in mp.sheds
+
+
+def test_shed_notification_definitive_and_suppressed_when_riding(
+        guard_runtime):
+    """The ACK_SHED push is DEFINITIVE: emitted only for a shed tx that
+    was still in the protocol queue and NOT riding an open proposal —
+    a proposal cannot be recalled, so such a tx may still commit and
+    the client must not be told otherwise."""
+    from hbbft_tpu.net.client import tx_digest as _digest
+
+    rt = guard_runtime
+    qhb = rt.sq.algo
+    tx_q = b"shed-unit-queued"
+    tx_r = b"shed-unit-riding"
+    qhb.queue.extend([tx_q, tx_r])
+    qhb._proposed[(99, 99)] = (tx_r,)        # riding an open epoch
+    try:
+        out = rt.pump_process(
+            [("shed", (tx_q,)), ("shed", (tx_r,)),
+             ("shed", (b"shed-unit-never-queued",))], depth=1)
+    finally:
+        qhb._proposed.pop((99, 99), None)
+    # only the queued-and-unproposed tx earns the notification; both
+    # queued txs still left the queue (consensus-side memory freed)
+    assert out.sheds == [_digest(tx_q)]
+    assert tx_q not in qhb.queue._set and tx_r not in qhb.queue._set
+
+
+def test_client_ack_shed_fails_commit_waiters_fast():
+    """Client side of the push: a pending ``wait_committed`` raises
+    :class:`TxShedError` promptly instead of riding out its timeout."""
+    import asyncio
+
+    from hbbft_tpu.net import framing
+    from hbbft_tpu.net.client import (ClusterClient, TxShedError,
+                                      tx_digest)
+
+    async def scenario():
+        c = ClusterClient(("127.0.0.1", 1), b"x")
+        digest = tx_digest(b"shed-me")
+        fut = asyncio.get_running_loop().create_future()
+        c._commits.setdefault(digest, []).append(fut)
+        c._submit_times[digest] = 0.0
+        c._on_frame(framing.TX_ACK,
+                    bytes([framing.ACK_SHED]) + digest)
+        with pytest.raises(TxShedError):
+            await fut
+        assert digest not in c._submit_times
+
+    asyncio.run(scenario())
+
+
+def test_mempool_shed_metrics_registered():
+    reg = Registry()
+    mp = Mempool(capacity=2, registry=reg)
+    mp.add(b"a", client_id="hog")
+    mp.add(b"b", client_id="hog")
+    mp.add(b"c", client_id="light")
+    assert reg.get("hbbft_guard_mempool_sheds_total").value(
+        client="hog") == 1
+
+
+# ---------------------------------------------------------------------------
+# Forensics: guard events → audit attribution
+
+
+def test_audit_attributes_overload_to_offending_peer(tmp_path):
+    from hbbft_tpu.obs.audit import format_report, run_audit
+    from hbbft_tpu.obs.flight import FlightRecorder
+
+    for node in ("0", "1"):
+        rec = FlightRecorder(str(tmp_path / f"node-{node}"), node=node)
+        rec.note("guard", "kind=throttle peer=3 why=bytes_per_s")
+        rec.note("guard", "kind=disconnect peer=3 why=decode_garbage "
+                          "backoff_s=2.0")
+        rec.close()
+    res, _journals = run_audit([str(tmp_path)])
+    assert res.verdict == "clean"         # defense working ≠ fault
+    (incident,) = res.overload_incidents
+    assert incident["peer"] == "3"
+    assert incident["kinds"] == {"disconnect": 2, "throttle": 2}
+    assert incident["witnesses"] == ["0", "1"]
+    assert "OVERLOAD: peer 3" in format_report(res)
+    assert res.as_dict()["overload_incidents"] == res.overload_incidents
+
+
+def test_flight_truncation_spans_incarnations(tmp_path):
+    """PR-8's named gap: checkpoint truncation must reason about
+    segments left by OLDER incarnations, so audits across restarts keep
+    the incident window without pinning stale segments forever."""
+    from hbbft_tpu.obs.flight import FlightRecorder
+
+    d = str(tmp_path / "node-0")
+    rec1 = FlightRecorder(d, node="0", max_segment_bytes=256,
+                          max_segments=64)
+    for i in range(40):
+        rec1.record_commit(0, i, i, bytes([i]) * 32)
+    rec1.close()
+    rec2 = FlightRecorder(d, node="0", max_segment_bytes=256,
+                          max_segments=64)
+    assert rec2.incarnation == 2
+    indexed = int(rec2._c_prior_indexed.total())
+    assert indexed > 1                    # rec1's segments are known
+    removed = rec2.truncate_checkpoint(30)
+    assert removed > 0                    # old-incarnation segments go
+    assert int(rec2._c_truncations.total()) == removed
+    # commits ≥ the horizon survive — the incident window is intact
+    from hbbft_tpu.obs.flight import FlightCommit, read_journal
+
+    rec2.record_commit(0, 40, 40, bytes([40]) * 32)
+    rec2.close()
+    j = read_journal(d)
+    commits = [r.index for _inc, r in j.records
+               if isinstance(r, FlightCommit)]
+    assert max(commits) == 40
+    assert any(c >= 30 for c in commits if c < 40)
