@@ -117,8 +117,13 @@ class DeterminismChecker(Checker):
     # chaos/ is in scope since the campaign runner: shaping decisions
     # and scenario schedules must come from the seeded RNG, or the
     # campaign's byte-identical-replay guarantee is fiction
+    # ops/rs.py joined the scope with the backend-switched erasure hot
+    # path: its per-backend STATS counters must stay plain ints (no
+    # clocks) — all three backends must produce byte-identical parity,
+    # and nondeterminism here forks the Merkle commitment
     scope = ("hbbft_tpu/protocols/", "hbbft_tpu/parallel/",
-             "hbbft_tpu/crypto/", "hbbft_tpu/chaos/")
+             "hbbft_tpu/crypto/", "hbbft_tpu/chaos/",
+             "hbbft_tpu/ops/rs.py")
     rules = {
         "det-wall-clock":
             "wall-clock read in consensus-core code (time.time, "

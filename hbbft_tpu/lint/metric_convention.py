@@ -24,7 +24,8 @@ from typing import Iterable, List, Optional, Tuple
 from hbbft_tpu.lint.core import Checker, Finding, Project, register
 
 NAME_CONVENTION = re.compile(
-    r"^hbbft_(net|node|phase|sim|obs|chaos|sync|guard)_[a-z][a-z0-9_]*$"
+    r"^hbbft_(net|node|phase|sim|obs|chaos|sync|guard|rbc|load)"
+    r"_[a-z][a-z0-9_]*$"
 )
 
 # a registration is a .counter( / .gauge( / .histogram( call whose first

@@ -9,6 +9,10 @@
 #include <cstring>
 #include <vector>
 
+#ifdef __AVX2__
+#include <immintrin.h>
+#endif
+
 namespace {
 
 struct Tables {
@@ -96,6 +100,79 @@ uint8_t gf_pow(uint8_t a, int e) {
   return T.exp[l];
 }
 
+// ---- SIMD constant-matrix apply -------------------------------------------
+//
+// The encode/decode matrices are tiny and fixed per (n, f) while the shard
+// byte count is MB-scale, so the profitable shape is "constant scalar times
+// long byte vector".  Each constant c gets a pair of 16-entry nibble tables
+//   TLO[x] = c * x          (x in 0..15)
+//   THI[x] = c * (x << 4)
+// and gf_mul(c, b) == TLO[b & 15] ^ THI[b >> 4] — two pshufb lookups per 32
+// bytes on AVX2 (the ISA-L trick).  Columns are walked in L2-sized tiles so
+// every B row of a tile stays cache-hot across the k accumulation passes.
+
+void build_nibble_tables(uint8_t c, uint8_t* tlo, uint8_t* thi) {
+  for (int x = 0; x < 16; ++x) {
+    tlo[x] = gf_mul(c, static_cast<uint8_t>(x));
+    thi[x] = gf_mul(c, static_cast<uint8_t>(x << 4));
+  }
+}
+
+// out(rows x cols) = A(rows x k) * B(k x cols), row-major; A is the small
+// constant matrix, B/out are shard-length rows.
+void matmul_simd(const uint8_t* A, const uint8_t* B, uint8_t* out, int rows,
+                 int k, int64_t cols) {
+  // nibble tables for every (row, j) constant, built once per call: the
+  // matrix is rows*k bytes, the data is rows*k*cols — negligible setup.
+  std::vector<uint8_t> tabs(static_cast<size_t>(rows) * k * 32);
+  for (int i = 0; i < rows; ++i)
+    for (int j = 0; j < k; ++j)
+      build_nibble_tables(A[i * k + j],
+                          &tabs[(static_cast<size_t>(i) * k + j) * 32],
+                          &tabs[(static_cast<size_t>(i) * k + j) * 32 + 16]);
+  const int64_t kTile = 1 << 16;  // 64 KiB column tile: k rows fit in L2
+  for (int64_t t0 = 0; t0 < cols; t0 += kTile) {
+    int64_t tlen = cols - t0 < kTile ? cols - t0 : kTile;
+    for (int i = 0; i < rows; ++i) {
+      uint8_t* orow = out + static_cast<size_t>(i) * cols + t0;
+      std::memset(orow, 0, static_cast<size_t>(tlen));
+      for (int j = 0; j < k; ++j) {
+        const uint8_t a = A[i * k + j];
+        if (a == 0) continue;
+        const uint8_t* brow = B + static_cast<size_t>(j) * cols + t0;
+        const uint8_t* tab = &tabs[(static_cast<size_t>(i) * k + j) * 32];
+        int64_t c = 0;
+#ifdef __AVX2__
+        const __m128i tlo128 = _mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(tab));
+        const __m128i thi128 = _mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(tab + 16));
+        const __m256i tlo = _mm256_broadcastsi128_si256(tlo128);
+        const __m256i thi = _mm256_broadcastsi128_si256(thi128);
+        const __m256i mask = _mm256_set1_epi8(0x0F);
+        for (; c + 32 <= tlen; c += 32) {
+          __m256i x = _mm256_loadu_si256(
+              reinterpret_cast<const __m256i*>(brow + c));
+          __m256i lo = _mm256_and_si256(x, mask);
+          __m256i hi = _mm256_and_si256(_mm256_srli_epi64(x, 4), mask);
+          __m256i prod = _mm256_xor_si256(_mm256_shuffle_epi8(tlo, lo),
+                                          _mm256_shuffle_epi8(thi, hi));
+          __m256i acc = _mm256_loadu_si256(
+              reinterpret_cast<const __m256i*>(orow + c));
+          _mm256_storeu_si256(reinterpret_cast<__m256i*>(orow + c),
+                              _mm256_xor_si256(acc, prod));
+        }
+#endif
+        const uint8_t* tlo8 = tab;
+        const uint8_t* thi8 = tab + 16;
+        for (; c < tlen; ++c)
+          orow[c] ^= static_cast<uint8_t>(tlo8[brow[c] & 0x0F] ^
+                                          thi8[brow[c] >> 4]);
+      }
+    }
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -108,6 +185,16 @@ void hbbft_gf_mul_bytes(const uint8_t* a, const uint8_t* b, uint8_t* out,
 void hbbft_gf_matmul(const uint8_t* A, const uint8_t* B, uint8_t* out,
                      int rows, int k, int cols) {
   matmul(A, B, out, rows, k, cols);
+}
+
+// SIMD apply of a CALLER-CACHED matrix (encode parity block or decode
+// inverse): unlike hbbft_rs_encode this never rebuilds the Vandermonde
+// system per call, which is what made the old per-call path O(matrix) on
+// top of O(bytes).  out must not alias B (parity tail vs data head of one
+// allocation is fine).
+void hbbft_gf_matmul_simd(const uint8_t* A, const uint8_t* B, uint8_t* out,
+                          int rows, int k, int64_t cols) {
+  matmul_simd(A, B, out, rows, k, cols);
 }
 
 int hbbft_gf_invert(const uint8_t* M, uint8_t* out, int n) {

@@ -41,6 +41,9 @@ class NativeOracle:
         u64p = ctypes.POINTER(ctypes.c_uint64)
         lib.hbbft_gf_mul_bytes.argtypes = [u8p, u8p, u8p, ctypes.c_int64]
         lib.hbbft_gf_matmul.argtypes = [u8p, u8p, u8p] + [ctypes.c_int] * 3
+        lib.hbbft_gf_matmul_simd.argtypes = [
+            u8p, u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int64,
+        ]
         lib.hbbft_gf_invert.argtypes = [u8p, u8p, ctypes.c_int]
         lib.hbbft_gf_invert.restype = ctypes.c_int
         lib.hbbft_rs_matrix.argtypes = [ctypes.c_int, ctypes.c_int, u8p]
@@ -110,6 +113,27 @@ class NativeOracle:
         assert k == k2
         out = np.empty((r, c), dtype=np.uint8)
         self._lib.hbbft_gf_matmul(self._p(A), self._p(B), self._p(out), r, k, c)
+        return out
+
+    def gf_matmul_simd(
+        self, A: np.ndarray, B: np.ndarray, out: Optional[np.ndarray] = None
+    ) -> np.ndarray:
+        """SIMD constant-matrix apply (AVX2 pshufb nibble tables).
+
+        Hot-path variant of :meth:`gf_matmul`: ``A`` is the small CACHED
+        encode/decode matrix, ``B`` the shard rows; ``out`` may be a view
+        into the caller's allocation (e.g. the parity tail of one
+        contiguous shard buffer) so encode writes in place with no copy.
+        """
+        r, k = A.shape
+        cols = int(B.shape[1])
+        assert A.flags.c_contiguous and B.flags.c_contiguous
+        if out is None:
+            out = np.empty((r, cols), dtype=np.uint8)
+        assert out.flags.c_contiguous and out.shape == (r, cols)
+        self._lib.hbbft_gf_matmul_simd(
+            self._p(A), self._p(B), self._p(out), r, k, cols
+        )
         return out
 
     def gf_invert(self, M: np.ndarray) -> np.ndarray:

@@ -33,7 +33,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
-from hbbft_tpu.net.client import ClusterClient
+from hbbft_tpu.net.client import ClusterClient, Mempool
 from hbbft_tpu.net.runtime import NodeRuntime
 from hbbft_tpu.netinfo import NetworkInfo
 from hbbft_tpu.protocols.dynamic_honey_badger import DynamicHoneyBadger
@@ -51,6 +51,11 @@ class ClusterConfig:
     host: str = "127.0.0.1"
     base_port: int = 0          # 0 → ephemeral ports (in-process only)
     batch_size: int = 8
+    # per-tx admission ceiling (Mempool.max_tx_bytes); 0 keeps the
+    # Mempool default (256 KiB).  batch_size × max_tx_bytes must fit in
+    # half the wire blob cap, so MB-scale ingestion shapes (big batches
+    # of small txs, or 64 KB txs) size this to the tx they carry
+    max_tx_bytes: int = 0
     encrypt: bool = False       # TPKE-encrypt contributions
     heartbeat_s: float = 0.5
     dead_after_s: float = 3.0
@@ -266,6 +271,8 @@ def build_algo(cfg: ClusterConfig, infos: Dict[int, NetworkInfo],
 
 def _shared_runtime_kwargs(cfg: ClusterConfig, nid: int) -> dict:
     return dict(
+        mempool=(Mempool(max_tx_bytes=cfg.max_tx_bytes)
+                 if cfg.max_tx_bytes else None),
         seed=cfg.seed * 1000 + nid,
         heartbeat_s=cfg.heartbeat_s,
         dead_after_s=cfg.dead_after_s,
@@ -593,6 +600,8 @@ def node_command(cfg: ClusterConfig, nid: int) -> List[str]:
         "--base-port", str(cfg.base_port),
         "--batch-size", str(cfg.batch_size),
     ]
+    if cfg.max_tx_bytes:
+        cmd += ["--max-tx-bytes", str(cfg.max_tx_bytes)]
     if cfg.metrics_base_port:
         cmd += ["--metrics-port", str(cfg.metrics_base_port + nid)]
     if cfg.flight_dir:
@@ -818,6 +827,9 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--base-port", type=int, required=True)
     ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--max-tx-bytes", type=int, default=0,
+                    help="per-tx admission ceiling in bytes "
+                         "(0 = Mempool default, 256 KiB)")
     ap.add_argument("--encrypt", action="store_true")
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="serve /metrics /status /spans /flight on this "
@@ -861,7 +873,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         ap.error(f"--node-id {args.node_id} not in 0..{args.nodes - 1}")
     cfg = ClusterConfig(
         n=args.nodes, seed=args.seed, base_port=args.base_port,
-        batch_size=args.batch_size, encrypt=args.encrypt,
+        batch_size=args.batch_size, max_tx_bytes=args.max_tx_bytes,
+        encrypt=args.encrypt,
         flight_dir=args.flight_dir, pipeline_depth=args.pipeline_depth,
         link_delays=args.link_delays,
         chaos=args.chaos, chaos_seed=args.chaos_seed,

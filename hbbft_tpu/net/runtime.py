@@ -57,6 +57,7 @@ from hbbft_tpu.obs.flight import FlightObserver, FlightRecorder
 from hbbft_tpu.obs.http import ObsServer
 from hbbft_tpu.obs.metrics import MetricAttr, Registry, fault_counter
 from hbbft_tpu.obs.spans import SpanTracer
+from hbbft_tpu.ops import rs as _rs
 from hbbft_tpu.protocols import wire
 from hbbft_tpu.protocols.dynamic_honey_badger import (
     DhbBatch,
@@ -214,8 +215,26 @@ class NodeRuntime:
             labelnames=("kind",), max_label_sets=4)
         for k in ("hb_future", "subset"):
             self._c_proto_drops.labels(kind=k)
+        # hbbft_rbc_*: erasure hot-path accounting.  ops/rs.py keeps
+        # deterministic plain-int counters (no registry dependency, no
+        # clocks — the module is in the determinism lint's scope); each
+        # scrape folds the delta since the last sync into real counters.
+        # The rs counters are process-global, so in-process multi-node
+        # harnesses see the shared total on every node's registry.
+        self._c_rbc_calls = self.registry.counter(
+            "hbbft_rbc_erasure_calls_total",
+            "erasure encode/decode matrix applications by backend",
+            labelnames=("backend",), max_label_sets=4)
+        self._c_rbc_bytes = self.registry.counter(
+            "hbbft_rbc_erasure_bytes_total",
+            "payload bytes through the erasure hot path by backend",
+            labelnames=("backend",), max_label_sets=4)
+        self._rs_stats_last = _rs.stats_snapshot()
         self.registry.register_callback(self._refresh_gauges)
-        self.mempool = mempool or Mempool()
+        # `is not None`, not `or`: Mempool defines __len__, so a freshly
+        # configured (empty → falsy) instance would be silently replaced
+        # by the default, discarding its max_tx_bytes sizing
+        self.mempool = mempool if mempool is not None else Mempool()
         self.mempool.bind_registry(self.registry)
         # the oversized-frame drop in _dispatch is a last-resort guard,
         # not a config escape hatch: a proposal of batch_size max-size txs
@@ -381,6 +400,15 @@ class NodeRuntime:
         surfaces PR 2 only logged — replay-log depth and each peer's
         last-acked (era, epoch) — now scrapeable instead of grep-able."""
         r = self.registry
+        for backend, cur in _rs.stats_snapshot().items():
+            last = self._rs_stats_last.get(backend, {})
+            d_calls = cur["calls"] - last.get("calls", 0)
+            d_bytes = cur["bytes"] - last.get("bytes", 0)
+            if d_calls > 0:
+                self._c_rbc_calls.labels(backend=backend).inc(d_calls)
+            if d_bytes > 0:
+                self._c_rbc_bytes.labels(backend=backend).inc(d_bytes)
+            self._rs_stats_last[backend] = dict(cur)
         era, epoch = self.current_key()
         r.gauge("hbbft_node_era", "current consensus era").set(era)
         r.gauge("hbbft_node_epoch", "current epoch within the era").set(epoch)

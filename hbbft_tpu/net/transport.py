@@ -513,6 +513,14 @@ class TransportStats:
             "hbbft_net_backoff_delay_seconds",
             "reconnect backoff delays drawn",
             buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0))
+        # egress fairness (guard family: bounded-resource enforcement):
+        # a drain round that hit its byte quantum with backlog remaining
+        # — the sender yielded the event loop instead of writing on
+        self._egress_stalls = r.counter(
+            "hbbft_guard_egress_stalls_total",
+            "per-peer egress drain rounds truncated at the byte quantum "
+            "with frames still queued (round-robin yield points)",
+            labelnames=("peer",), max_label_sets=33)
         self.reconnects = _LabeledCounterView(self._reconnects)
         self.backoff_delays: Dict[NodeId, List[float]] = {}
         # hot-path handles: _record_send/_record_recv run per frame, and
@@ -531,6 +539,9 @@ class TransportStats:
     def frame_recv(self, nbytes: int) -> None:
         self._c_frames_recv.inc()
         self._c_bytes_recv.inc(nbytes)
+
+    def egress_stall(self, peer_id: NodeId) -> None:
+        self._egress_stalls.labels(peer=repr(peer_id)).inc()
 
     # -- attribute views (the pre-registry dataclass API) -------------------
 
@@ -770,16 +781,27 @@ class _PeerSender:
                         )
 
         async def drainer():
+            quantum = self.t.egress_quantum_bytes
             while True:
                 await self.wake.wait()
                 self.wake.clear()
                 while self.outbox:
-                    # write every queued frame, then ONE drain for the
-                    # lot — per-frame drains cost a writer round trip
-                    # each and dominated the sequential-path profile.
-                    # (Link shaping happens BEFORE the outbox — see
-                    # send(): a queued frame is already due.)
-                    batch = list(self.outbox)
+                    # write queued frames up to the byte QUANTUM, then ONE
+                    # drain for the lot — per-frame drains cost a writer
+                    # round trip each and dominated the sequential-path
+                    # profile, while an unbounded batch lets one peer's
+                    # MB-scale shard backlog monopolize the event loop
+                    # (every other peer's drainer and the recv loops wait
+                    # behind the memcpy).  (Link shaping happens BEFORE
+                    # the outbox — see send(): a queued frame is already
+                    # due.)
+                    batch = []
+                    nbytes = 0
+                    for f in self.outbox:
+                        batch.append(f)
+                        nbytes += len(f)
+                        if nbytes >= quantum:
+                            break
                     async with wlock:
                         for f in batch:
                             writer.write(f)
@@ -790,6 +812,11 @@ class _PeerSender:
                     for f in batch:
                         self.outbox.popleft()
                         self.t._record_send(self.peer_id, f)
+                    if self.outbox:
+                        # counted yield point: round-robin fairness across
+                        # peers is observable, not assumed
+                        self.t.stats.egress_stall(self.peer_id)
+                        await asyncio.sleep(0)
 
         async def ping_once():
             frame = framing.encode_frame(
@@ -882,6 +909,7 @@ class Transport:
         connect_timeout_s: float = 2.0,
         client_idle_timeout_s: float = 60.0,
         max_frame: int = DEFAULT_MAX_FRAME,
+        egress_quantum_bytes: int = 256 * 1024,
         backoff: Optional[BackoffPolicy] = None,
         trace=None,
         cost_model=None,
@@ -906,6 +934,10 @@ class Transport:
         self.connect_timeout_s = connect_timeout_s
         self.client_idle_timeout_s = client_idle_timeout_s
         self.max_frame = max_frame
+        # egress fairness: a drainer round writes at most this many bytes
+        # before draining and yielding — bounds any single peer's hold on
+        # the event loop (counted: hbbft_guard_egress_stalls_total)
+        self.egress_quantum_bytes = int(egress_quantum_bytes)
         self.backoff = backoff or BackoffPolicy(seed=seed)
         self.trace = trace
         self.cost_model = cost_model

@@ -122,6 +122,138 @@ def vandermonde(rows: int, cols: int) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Cached bitmatrix-XOR schedule (host hot path)
+# ---------------------------------------------------------------------------
+#
+# "Accelerating XOR-based Erasure Coding using Program Optimization
+# Techniques" playbook: a constant GF(2^8) matrix is a GF(2) bitmatrix, so
+# applying it is pure XORs of bit-planes.  The schedule is computed ONCE per
+# matrix (the per-call cost of the old table-matmul path was the whole
+# problem), common XOR subexpressions are eliminated greedily, and execution
+# walks the shards in column tiles so every plane of a tile stays cache-hot.
+
+
+class XorSchedule:
+    """A straight-line XOR program for one constant bit matrix.
+
+    Nodes ``0..n_in-1`` are the input bit-planes (input symbol ``k``, bit
+    ``i`` → node ``k*8 + i``).  Each op ``(dest, a, b)`` defines node
+    ``dest = a ^ b`` (the CSE intermediates, in dependency order).
+    ``outputs[j]`` lists the node ids whose XOR is output bit-row ``j``
+    (output symbol ``j // 8``, bit ``j % 8``).
+    """
+
+    __slots__ = ("n_in", "ops", "outputs", "xor_count")
+
+    def __init__(self, n_in, ops, outputs):
+        self.n_in = n_in
+        self.ops = ops
+        self.outputs = outputs
+        self.xor_count = len(ops) + sum(
+            max(0, len(o) - 1) for o in outputs
+        )
+
+
+def build_xor_schedule(bitmat: np.ndarray) -> XorSchedule:
+    """Compile a (k*8, r*8) bit matrix into an :class:`XorSchedule`.
+
+    Greedy pairwise common-subexpression elimination: repeatedly extract
+    the operand pair shared by the most output rows into an intermediate
+    node.  Fully deterministic (ties break on the smallest pair), so the
+    schedule — and therefore the XOR order — is a pure function of the
+    matrix.
+    """
+    bitmat = np.asarray(bitmat)
+    n_in, n_out = bitmat.shape
+    sets = [
+        set(int(i) for i in np.nonzero(bitmat[:, j])[0])
+        for j in range(n_out)
+    ]
+    ops = []
+    next_id = n_in
+    while True:
+        counts: dict = {}
+        for s in sets:
+            if len(s) < 2:
+                continue
+            ss = sorted(s)
+            for x in range(len(ss)):
+                for y in range(x + 1, len(ss)):
+                    p = (ss[x], ss[y])
+                    counts[p] = counts.get(p, 0) + 1
+        if not counts:
+            break
+        best_count = max(counts.values())
+        if best_count < 2:
+            break
+        a, b = min(p for p, c in counts.items() if c == best_count)
+        ops.append((next_id, a, b))
+        for s in sets:
+            if a in s and b in s:
+                s.discard(a)
+                s.discard(b)
+                s.add(next_id)
+        next_id += 1
+    return XorSchedule(n_in, ops, [sorted(s) for s in sets])
+
+
+_BIT_WEIGHTS = np.left_shift(1, np.arange(8)).astype(np.uint8)
+
+
+def apply_xor_schedule(
+    sched: XorSchedule,
+    data: np.ndarray,
+    out: np.ndarray = None,
+    tile_bytes: int = 1 << 15,
+) -> np.ndarray:
+    """Run a schedule over shard rows: (k, B) uint8 → (r, B) uint8.
+
+    ``out`` may be a view into the caller's allocation (the parity tail of
+    one contiguous buffer).  Columns are processed ``tile_bytes`` at a time
+    so the k+r+intermediate bit-planes of a tile fit in cache.
+    """
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    k, B = data.shape
+    assert k * 8 == sched.n_in
+    r8 = len(sched.outputs)
+    r = r8 // 8
+    if out is None:
+        out = np.empty((r, B), dtype=np.uint8)
+    n_nodes = sched.n_in + len(sched.ops)
+    for t0 in range(0, B, tile_bytes):
+        tile = data[:, t0:t0 + tile_bytes]
+        T = tile.shape[1]
+        # decompose: (k, 8, T) bit arrays → packed planes (k*8, ceil(T/8))
+        bits = (
+            tile[:, None, :] >> np.arange(8, dtype=np.uint8)[None, :, None]
+        ) & 1
+        planes = np.packbits(bits != 0, axis=-1, bitorder="little")
+        planes = planes.reshape(k * 8, -1)
+        nodes: list = [None] * n_nodes
+        for i in range(k * 8):
+            nodes[i] = planes[i]
+        for dest, a, b in sched.ops:
+            nodes[dest] = nodes[a] ^ nodes[b]
+        W = planes.shape[1]
+        obits = np.zeros((r8, W), dtype=np.uint8)
+        for j, ids in enumerate(sched.outputs):
+            if not ids:
+                continue
+            acc = nodes[ids[0]]
+            for nid in ids[1:]:
+                acc = acc ^ nodes[nid]
+            obits[j] = acc
+        # repack: unpack each output plane and recombine the 8 bit rows
+        ob = np.unpackbits(
+            obits.reshape(r, 8, W), axis=-1, bitorder="little"
+        )[..., :T]
+        out[:, t0:t0 + T] = (
+            ob * _BIT_WEIGHTS[None, :, None]
+        ).sum(axis=1, dtype=np.uint8)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Bit-plane lowering (device path)
 # ---------------------------------------------------------------------------
 

@@ -20,9 +20,59 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from hbbft_tpu.ops.keccak import sha3_256_host
 
 Digest = bytes  # 32 bytes
+
+# Below this many total bytes the per-call overhead of the native batch
+# hasher beats its 4-way SIMD win; small trees stay on hashlib.
+_BATCH_MIN_BYTES = 2048
+
+_batch_fn = None
+_batch_checked = False
+
+
+def _sha3_batch():
+    """Native equal-length batch hasher ((n, L) uint8 → (n, 32)), or None."""
+    global _batch_fn, _batch_checked
+    if not _batch_checked:
+        _batch_checked = True
+        try:
+            from hbbft_tpu.native.oracle import get_oracle
+
+            _batch_fn = get_oracle().sha3_256_batch
+        except Exception:
+            _batch_fn = None
+    return _batch_fn
+
+
+def _hash_rows(arr: np.ndarray) -> List[Digest]:
+    """Digest every row of a contiguous (n, L) uint8 array, batched."""
+    batch = _sha3_batch()
+    if batch is not None and arr.size >= _BATCH_MIN_BYTES:
+        dig = batch(arr)
+        return [dig[i].tobytes() for i in range(arr.shape[0])]
+    return [sha3_256_host(arr[i].tobytes()) for i in range(arr.shape[0])]
+
+
+def _leaf_digests(values: Sequence[bytes]) -> List[Digest]:
+    """Leaf hashing: equal-length leaf sets go through the batch hasher
+    (commitment cost scales with bytes, not leaves); ragged sets fall back
+    to per-leaf hashlib."""
+    n = len(values)
+    if n >= 2:
+        L = len(values[0])
+        if L > 0 and n * L >= _BATCH_MIN_BYTES and all(
+            len(v) == L for v in values
+        ) and _sha3_batch() is not None:
+            arr = np.empty((n, L), dtype=np.uint8)
+            for i, v in enumerate(values):
+                arr[i] = np.frombuffer(v, dtype=np.uint8)
+            dig = _sha3_batch()(arr)
+            return [dig[i].tobytes() for i in range(n)]
+    return [sha3_256_host(v) for v in values]
 
 
 @dataclass(frozen=True)
@@ -38,6 +88,18 @@ class Proof:
     index: int
     root_hash: Digest
     path: Tuple[Tuple[Digest, bool], ...]
+
+    def __getstate__(self):
+        # zero-copy proofs hold memoryview leaves, which neither pickle
+        # nor deepcopy; snapshots materialize the slice here — the one
+        # cold path where the copy is the point
+        state = dict(self.__dict__)
+        if isinstance(state["value"], memoryview):
+            state["value"] = bytes(state["value"])
+        return state
+
+    def __setstate__(self, state) -> None:
+        self.__dict__.update(state)  # bypasses the frozen __setattr__
 
     def validate(self, n_leaves: int) -> bool:
         """Check the proof against its own root (and index bounds).
@@ -72,22 +134,66 @@ class MerkleTree:
     def __init__(self, values: Sequence[bytes]):
         if not values:
             raise ValueError("MerkleTree needs at least one leaf")
-        self.values: List[bytes] = [bytes(v) for v in values]
-        self.levels: List[List[Digest]] = [
-            [sha3_256_host(v) for v in self.values]
-        ]
-        while len(self.levels[-1]) > 1:
-            prev = self.levels[-1]
-            nxt = []
-            for i in range(0, len(prev) - 1, 2):
-                nxt.append(sha3_256_host(prev[i] + prev[i + 1]))
+        # bytes and memoryview leaves are stored as-is (memoryview slices of
+        # one shared buffer make the proposer path zero-copy); anything else
+        # is converted once, and the conversion count is exposed so the
+        # hot-path test can assert the pipeline stays copy-free
+        self.values: List[bytes] = []
+        self.leaf_copies = 0
+        for v in values:
+            if not isinstance(v, (bytes, memoryview)):
+                v = bytes(v)
+                self.leaf_copies += 1
+            self.values.append(v)
+        self.levels: List[List[Digest]] = self._build_levels(
+            _leaf_digests(self.values)
+        )
+
+    @staticmethod
+    def _build_levels(level0: List[Digest]) -> List[List[Digest]]:
+        levels = [level0]
+        while len(levels[-1]) > 1:
+            prev = levels[-1]
+            pairs = len(prev) // 2
+            if pairs * 64 >= _BATCH_MIN_BYTES and _sha3_batch() is not None:
+                buf = np.frombuffer(
+                    b"".join(prev[: 2 * pairs]), dtype=np.uint8
+                ).reshape(pairs, 64)
+                nxt = _hash_rows(buf)
+            else:
+                nxt = [
+                    sha3_256_host(prev[i] + prev[i + 1])
+                    for i in range(0, len(prev) - 1, 2)
+                ]
             if len(prev) % 2 == 1:
                 nxt.append(prev[-1])  # odd carry
-            self.levels.append(nxt)
+            levels.append(nxt)
+        return levels
 
     @classmethod
     def from_vec(cls, values: Sequence[bytes]) -> "MerkleTree":
         return cls(values)
+
+    @classmethod
+    def from_shards(
+        cls, arr: np.ndarray, leaves: Sequence[bytes]
+    ) -> "MerkleTree":
+        """Build from a contiguous (n, B) uint8 shard array without copying.
+
+        ``arr`` feeds the batch hasher directly; ``leaves`` supplies the
+        per-shard buffers stored as proof values (typically memoryview
+        slices of ONE bytes object over the same shard data) — the encode →
+        commit path of :mod:`hbbft_tpu.protocols.broadcast` touches each
+        shard byte exactly once here.
+        """
+        n, B = arr.shape
+        if n != len(leaves) or any(len(v) != B for v in leaves):
+            raise ValueError("leaves must mirror the shard array")
+        tree = cls.__new__(cls)
+        tree.values = list(leaves)
+        tree.leaf_copies = 0
+        tree.levels = cls._build_levels(_hash_rows(arr))
+        return tree
 
     def root_hash(self) -> Digest:
         return self.levels[-1][0]

@@ -16,11 +16,72 @@ leading axes (node × instance × epoch).
 from __future__ import annotations
 
 import functools
+import os
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from hbbft_tpu.ops import gf256
+
+# ---------------------------------------------------------------------------
+# Erasure backend switch (mirrors the HBBFT_ENCRYPT_BACKEND roofline pattern)
+# ---------------------------------------------------------------------------
+#
+# HBBFT_ERASURE_BACKEND selects the host encode/decode engine:
+#   native — AVX2 pshufb nibble tables over the CACHED matrix (gf256.cpp)
+#   numpy  — cached bitmatrix-XOR schedule (packed bit-planes, CSE, tiling)
+#   jax    — the bit-plane MXU matmul (device roofline path)
+#   auto   — native when the oracle library loads, else numpy (default)
+#
+# All backends are byte-identical (pinned by tests/test_rs_backends.py);
+# the switch trades setup cost against per-byte throughput.
+
+_BACKENDS = ("auto", "native", "numpy", "jax")
+
+# Per-backend work counters (bytes = shard bytes produced).  Plain ints —
+# this module sits in the determinism-lint scope, so no clocks here; the
+# runtime snapshots these into hbbft_rbc_* metrics.
+STATS = {b: {"calls": 0, "bytes": 0} for b in ("native", "numpy", "jax")}
+
+
+def stats_snapshot():
+    """Copy of the per-backend encode/decode work counters."""
+    return {b: dict(v) for b, v in STATS.items()}
+
+
+_native_oracle = None
+_native_checked = False
+
+
+def _native():
+    """The ctypes oracle, or None when the library can't build/load."""
+    global _native_oracle, _native_checked
+    if not _native_checked:
+        _native_checked = True
+        try:
+            from hbbft_tpu.native.oracle import get_oracle
+
+            _native_oracle = get_oracle()
+        except Exception:
+            _native_oracle = None
+    return _native_oracle
+
+
+def resolve_backend() -> str:
+    """The effective erasure backend for this process."""
+    mode = os.environ.get("HBBFT_ERASURE_BACKEND", "auto")
+    if mode not in _BACKENDS:
+        raise ValueError(
+            f"HBBFT_ERASURE_BACKEND={mode!r}: want one of {_BACKENDS}"
+        )
+    if mode == "auto":
+        return "native" if _native() is not None else "numpy"
+    if mode == "native" and _native() is None:
+        raise RuntimeError(
+            "HBBFT_ERASURE_BACKEND=native but the oracle library "
+            "failed to build/load"
+        )
+    return mode
 
 
 class ReedSolomon:
@@ -48,6 +109,11 @@ class ReedSolomon:
         self.parity_matrix = self.matrix[data_shards:]  # (parity, data)
         self._parity_bits = gf256.gf_matrix_to_bits(self.parity_matrix)
         self._decode_cache = {}
+        # per-matrix compiled artifacts, built lazily ONCE and reused for
+        # every call (the old path rebuilt its gather indices per call):
+        # key → XorSchedule (numpy backend) / bit matrix (jax backend)
+        self._sched_cache = {}
+        self._bits_cache = {}
 
     # ------------------------------------------------------------------ host
     def encode_np(self, data: np.ndarray) -> np.ndarray:
@@ -56,8 +122,29 @@ class ReedSolomon:
         assert data.shape[0] == self.data_shards
         if self.parity_shards == 0:
             return data.copy()
-        parity = gf256.gf_matmul_np(self.parity_matrix, data)
-        return np.concatenate([data, parity], axis=0)
+        out = np.empty(
+            (self.total_shards, data.shape[1]), dtype=np.uint8
+        )
+        out[: self.data_shards] = data
+        self.encode_into(out)
+        return out
+
+    def encode_into(self, shards: np.ndarray) -> np.ndarray:
+        """Fill parity rows of a contiguous (total, B) buffer in place.
+
+        The zero-copy encode primitive: data rows are already where they
+        belong, parity is written into the tail of the same allocation, so
+        the full shard set exists in ONE buffer with no concatenate.
+        """
+        assert shards.shape[0] == self.total_shards
+        if self.parity_shards:
+            self._apply_matrix(
+                ("parity",),
+                self.parity_matrix,
+                shards[: self.data_shards],
+                out=shards[self.data_shards:],
+            )
+        return shards
 
     def verify_np(self, shards: np.ndarray) -> bool:
         """True iff parity shards are consistent with data shards."""
@@ -73,9 +160,9 @@ class ReedSolomon:
         """
         def decode(sub, use):
             dec = self._decode_matrix(tuple(use))
-            data = gf256.gf_matmul_np(dec, sub)
+            data = self._apply_matrix(("dec", tuple(use)), dec, sub)
             return (
-                gf256.gf_matmul_np(self.matrix, data)
+                self._apply_matrix(("full",), self.matrix, data)
                 if self.parity_shards else data
             )
 
@@ -87,6 +174,43 @@ class ReedSolomon:
             sub = self.matrix[list(use)]  # (data, data)
             self._decode_cache[use] = gf256.gf_inv_matrix_np(sub)
         return self._decode_cache[use]
+
+    def _apply_matrix(self, key, matrix, data, out=None):
+        """Backend-dispatched constant-matrix apply with cached artifacts.
+
+        ``key`` identifies the matrix in the per-coder caches (the matrix
+        itself is never rebuilt, and neither is its compiled form).
+        """
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        backend = resolve_backend()
+        if backend == "native":
+            out = _native().gf_matmul_simd(matrix, data, out=out)
+        elif backend == "jax":
+            import jax.numpy as jnp
+
+            bits = self._bits_cache.get(key)
+            if bits is None:
+                bits = self._bits_cache[key] = gf256.gf_matrix_to_bits(
+                    matrix
+                )
+            res = np.asarray(
+                gf256.gf_apply_bitmatrix(data.T, jnp.asarray(bits))
+            ).T
+            if out is None:
+                out = np.ascontiguousarray(res)
+            else:
+                out[:] = res
+        else:
+            sched = self._sched_cache.get(key)
+            if sched is None:
+                sched = self._sched_cache[key] = gf256.build_xor_schedule(
+                    gf256.gf_matrix_to_bits(matrix)
+                )
+            out = gf256.apply_xor_schedule(sched, data, out=out)
+        s = STATS[backend]
+        s["calls"] += 1
+        s["bytes"] += int(out.shape[0]) * int(out.shape[1])
+        return out
 
     # ---------------------------------------------------------------- device
     def encode_jax(self, data):
@@ -193,6 +317,15 @@ class ReedSolomon16:
         D = self._to_symbols(data)
         parity = self.gf.gf_matmul_np(self.parity_matrix, D)
         return np.concatenate([data, self._from_symbols(parity)], axis=0)
+
+    def encode_into(self, shards: np.ndarray) -> np.ndarray:
+        """Same in-place contract as :meth:`ReedSolomon.encode_into`."""
+        assert shards.shape[0] == self.total_shards
+        if self.parity_shards:
+            D = self._to_symbols(shards[: self.data_shards])
+            parity = self.gf.gf_matmul_np(self.parity_matrix, D)
+            shards[self.data_shards:] = self._from_symbols(parity)
+        return shards
 
     def encode_jax(self, data, parity_bits=None):
         """uint8 (..., data_shards, B) → (..., total_shards, B), B even.

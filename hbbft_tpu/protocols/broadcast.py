@@ -153,9 +153,8 @@ class Broadcast(ConsensusProtocol):
         ``parallel.rbc.BatchedRbc.propose``).
         """
         self.value_received = True
-        data = _frame_value(value, self.data_shard_num)
-        shards = self.coder.encode_np(data)
-        tree = MerkleTree.from_vec([s.tobytes() for s in shards])
+        shards, leaves = _encode_value(self.coder, value)
+        tree = MerkleTree.from_shards(shards, leaves)
         step = Step()
         my_proof = None
         ids = self.netinfo.all_ids()
@@ -401,6 +400,40 @@ def _frame_value(value: bytes, data_shards: int) -> np.ndarray:
     shard_len += shard_len % 2
     framed = framed.ljust(data_shards * shard_len, b"\0")
     return np.frombuffer(framed, dtype=np.uint8).reshape(data_shards, shard_len)
+
+
+def _encode_value(coder, value: bytes):
+    """Frame + RS-encode ``value`` into ONE contiguous shard buffer.
+
+    Returns ``(shards, leaves)``: ``shards`` is the (total, B) uint8 array
+    (data rows framed in place, parity written into the tail by
+    ``encode_into``), ``leaves`` are memoryview slices of a SINGLE immutable
+    bytes snapshot of it.  The Merkle tree hashes the array rows directly
+    and the per-peer proofs carry the shared slices, so the proposer path
+    copies each payload byte O(1) times total — the old path round-tripped
+    every shard through ``tobytes()`` and re-materialized it per peer."""
+    k = coder.data_shards
+    framed_len = 4 + len(value)
+    shard_len = max(2, -(-framed_len // k))
+    shard_len += shard_len % 2
+    # empty + explicit tail-zero, not zeros: calloc hands back fresh
+    # lazily-mapped pages every call, and the page faults land on the
+    # encode/hash steps that first touch them — malloc reuse keeps the
+    # hot loop on warm pages.  Parity rows are fully overwritten below.
+    shards = np.empty((coder.total_shards, shard_len), dtype=np.uint8)
+    flat = shards[:k].reshape(-1)
+    flat[:4] = np.frombuffer(len(value).to_bytes(4, "big"), dtype=np.uint8)
+    if value:
+        flat[4:framed_len] = np.frombuffer(value, dtype=np.uint8)
+    flat[framed_len:] = 0
+    coder.encode_into(shards)
+    buf = shards.tobytes()  # the one immutable snapshot all slices share
+    mv = memoryview(buf)
+    leaves = [
+        mv[i * shard_len:(i + 1) * shard_len]
+        for i in range(coder.total_shards)
+    ]
+    return shards, leaves
 
 
 def _unframe_value(framed: bytes) -> Optional[bytes]:

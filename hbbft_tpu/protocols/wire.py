@@ -93,8 +93,10 @@ class Reader:
         return self.pos == len(self.data)
 
 
-def blob(b: bytes) -> bytes:
-    return struct.pack(">I", len(b)) + b
+def blob(b) -> bytes:
+    # join (not +) so memoryview values — the zero-copy RBC proof slices —
+    # encode without a bytes() conversion at every call site
+    return b"".join((struct.pack(">I", len(b)), b))
 
 
 def u32(v: int) -> bytes:

@@ -124,3 +124,46 @@ def test_depth_change_skips_per_epoch_metrics():
     report = bench.compare_bench(old, same, threshold=0.15)
     assert report["epoch_metrics_compared"]
     assert "phases.epoch_wall_p50_ms" in report["regressions"]
+
+
+def _sweep_cell(tx_bytes, batch, tx_per_s, mb_per_s):
+    return {"tx_bytes": tx_bytes, "batch": batch,
+            "tx_per_s": tx_per_s, "mb_per_s": mb_per_s}
+
+
+def test_ingest_sweep_gates_at_equal_shape_only():
+    """Per-shape tx/s + MB/s are higher-better and compared only when
+    BOTH recordings ran the same (tx_bytes, batch) cell; added or
+    dropped cells are ignored."""
+    old = _line()
+    old["ingest_sweep"] = [
+        _sweep_cell(64, 8, 700.0, 0.043),
+        _sweep_cell(65536, 8, 40.0, 2.5),
+        _sweep_cell(4096, 256, 900.0, 3.5),   # dropped in new
+    ]
+    new = _line()
+    new["ingest_sweep"] = [
+        _sweep_cell(64, 8, 750.0, 0.046),     # improved: ok
+        _sweep_cell(65536, 8, 20.0, 1.25),    # halved: regression
+        _sweep_cell(64, 4096, 5000.0, 0.3),   # new cell: ignored
+    ]
+    report = bench.compare_bench(old, new, threshold=0.15)
+    assert report["regressions"] == [
+        "ingest[65536B x8].tx_per_s", "ingest[65536B x8].mb_per_s",
+    ]
+    names = {c["name"] for c in report["checks"]}
+    assert "ingest[64B x8].tx_per_s" in names
+    assert "ingest[64B x8].mb_per_s" in names
+    # shapes present on only one side contribute no checks
+    assert not any("4096B x256" in n or "64B x4096" in n for n in names)
+
+
+def test_ingest_sweep_absent_or_empty_is_trivially_ok():
+    """r03-era recordings predate the sweep: comparing them against an
+    r04 artifact (or vice versa) must not fail on the missing key."""
+    old = _line()
+    new = _line()
+    new["ingest_sweep"] = [_sweep_cell(64, 8, 700.0, 0.043)]
+    report = bench.compare_bench(old, new, threshold=0.15)
+    assert report["ok"]
+    assert not any(c["name"].startswith("ingest[") for c in report["checks"])

@@ -279,3 +279,35 @@ def test_hello_carries_current_epoch_key():
         await tb.stop()
 
     asyncio.run(asyncio.wait_for(scenario(), 20))
+
+
+def test_egress_quantum_round_robin_counted():
+    """A backlog deeper than the byte quantum is drained in counted
+    rounds (hbbft_guard_egress_stalls_total): the sender yields the
+    event loop between quanta instead of monopolizing it, and every
+    frame still arrives in order."""
+
+    async def scenario():
+        got = []
+        tb = Transport(1, b"cl",
+                       on_peer_message=lambda pid, d: got.append(d))
+        await tb.listen()
+        # 4 KiB quantum, 40 × 1 KiB frames → many truncated rounds
+        ta = Transport(0, b"cl", egress_quantum_bytes=4096)
+        await ta.listen()
+        tb.add_peer(0, ta.addr)
+        ta.add_peer(1, tb.addr)
+        frames = [bytes([i]) * 1024 for i in range(40)]
+        for p in frames:
+            ta.send(1, p)
+        for _ in range(400):
+            if len(got) == len(frames):
+                break
+            await asyncio.sleep(0.01)
+        assert got == frames  # all delivered, in order
+        stalls = ta.stats._egress_stalls.total()
+        assert stalls > 0, "deep backlog must hit the quantum"
+        await ta.stop()
+        await tb.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 20))

@@ -1,0 +1,217 @@
+"""Erasure-backend equivalence + zero-copy hot-path regressions.
+
+The MB-scale ingestion work split the RS hot path into three engines
+(HBBFT_ERASURE_BACKEND = native / numpy / jax) that MUST stay
+byte-identical — the Merkle root commits to the exact parity bytes, so a
+single differing byte forks consensus between nodes running different
+backends.  These tests pin:
+
+  * encode byte-equality across all loadable backends, over shard sizes
+    64 B → 64 KB including odd lengths, for every shipped (n, f) shape;
+  * reconstruction from every f-sized erasure pattern (bounded
+    deterministic sample at n = 16 where C(16,5) = 4368);
+  * the proposer encode→commit path staying copy-free (one immutable
+    snapshot shared by the Merkle tree and every per-peer proof).
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from hbbft_tpu.ops import rs
+from hbbft_tpu.ops.merkle import MerkleTree
+from hbbft_tpu.protocols import wire
+from hbbft_tpu.protocols.broadcast import _encode_value, _unframe_value
+
+# (n, f) → (data, parity) = (n − 2f, 2f)
+SHAPES = [(4, 1), (7, 2), (10, 3), (16, 5)]
+
+# shard byte lengths: tiny, odd, unaligned, tile-boundary, large
+SHARD_LENS = [64, 63, 65, 1024, 4097, 32768, 65536]
+
+
+def _backends():
+    """Backends loadable in this environment (numpy always; native when
+    the oracle builds; jax when importable)."""
+    out = ["numpy"]
+    try:
+        from hbbft_tpu.native.oracle import get_oracle
+
+        get_oracle()
+        out.append("native")
+    except Exception:
+        pass
+    try:
+        import jax  # noqa: F401
+
+        out.append("jax")
+    except Exception:
+        pass
+    return out
+
+
+BACKENDS = _backends()
+
+
+def _rng(seed):
+    return np.random.default_rng(seed)
+
+
+def _encode_with(monkeypatch, backend, coder, data):
+    monkeypatch.setenv("HBBFT_ERASURE_BACKEND", backend)
+    return coder.encode_np(data)
+
+
+@pytest.mark.parametrize("n,f", SHAPES)
+def test_encode_byte_equality_across_backends(monkeypatch, n, f):
+    coder = rs.ReedSolomon(n - 2 * f, 2 * f)
+    # jax re-traces per distinct shape — keep its sweep to a subset
+    lens_by_backend = {"jax": [64, 1024]}
+    for B in SHARD_LENS:
+        data = _rng(1000 * n + B).integers(
+            0, 256, size=(coder.data_shards, B), dtype=np.uint8
+        )
+        ref = _encode_with(monkeypatch, "numpy", coder, data)
+        assert ref.shape == (coder.total_shards, B)
+        # systematic: data rows pass through untouched
+        assert np.array_equal(ref[: coder.data_shards], data)
+        for backend in BACKENDS:
+            if backend == "numpy":
+                continue
+            if B not in lens_by_backend.get(backend, SHARD_LENS):
+                continue
+            got = _encode_with(monkeypatch, backend, coder, data)
+            assert np.array_equal(got, ref), (
+                f"backend {backend} diverges at n={n} f={f} B={B}"
+            )
+
+
+@pytest.mark.parametrize("n,f", SHAPES)
+def test_reconstruct_every_erasure_pattern(monkeypatch, n, f):
+    monkeypatch.setenv("HBBFT_ERASURE_BACKEND", "numpy")
+    coder = rs.ReedSolomon(n - 2 * f, 2 * f)
+    B = 64
+    data = _rng(7 * n).integers(
+        0, 256, size=(coder.data_shards, B), dtype=np.uint8
+    )
+    full = [bytes(row) for row in coder.encode_np(data)]
+    patterns = itertools.combinations(range(n), f)
+    if n >= 16:
+        # C(16,5) = 4368 — deterministic stride sample keeps tier-1 fast
+        patterns = list(patterns)[::37]
+    for erased in patterns:
+        shards = [
+            None if i in erased else full[i] for i in range(n)
+        ]
+        got = coder.reconstruct_np(shards)
+        assert got == full, f"pattern {erased} reconstructed wrong"
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_reconstruct_backend_equality(monkeypatch, backend):
+    """Decode-side matrices run through the same backend dispatch."""
+    monkeypatch.setenv("HBBFT_ERASURE_BACKEND", backend)
+    coder = rs.ReedSolomon(5, 4)  # n=9, f=2
+    B = 1026
+    data = _rng(42).integers(0, 256, size=(5, B), dtype=np.uint8)
+    full = [bytes(row) for row in coder.encode_np(data)]
+    shards = [None, full[1], None, full[3], full[4], full[5], None, full[7], full[8]]
+    assert coder.reconstruct_np(shards) == full
+
+
+def test_backend_switch_validation(monkeypatch):
+    monkeypatch.setenv("HBBFT_ERASURE_BACKEND", "bogus")
+    with pytest.raises(ValueError):
+        rs.resolve_backend()
+    monkeypatch.delenv("HBBFT_ERASURE_BACKEND")
+    assert rs.resolve_backend() in ("native", "numpy")
+
+
+def test_stats_counters_advance(monkeypatch):
+    monkeypatch.setenv("HBBFT_ERASURE_BACKEND", "numpy")
+    before = rs.stats_snapshot()["numpy"]
+    coder = rs.ReedSolomon(2, 2)
+    coder.encode_np(np.zeros((2, 128), dtype=np.uint8))
+    after = rs.stats_snapshot()["numpy"]
+    assert after["calls"] == before["calls"] + 1
+    assert after["bytes"] == before["bytes"] + 2 * 128
+
+
+# ---------------------------------------------------------------------------
+# Zero-copy proposer hot path
+# ---------------------------------------------------------------------------
+
+
+def test_encode_value_zero_copy():
+    """encode→commit shares ONE immutable snapshot: no per-leaf copies,
+    every proof value a memoryview slice of the same buffer."""
+    coder = rs.for_n_f(4, 1)
+    value = bytes(range(256)) * 128  # 32 KB
+    shards, leaves = _encode_value(coder, value)
+    tree = MerkleTree.from_shards(shards, leaves)
+    assert tree.leaf_copies == 0
+    bufs = {mv.obj for mv in tree.values}
+    assert len(bufs) == 1, "leaves must share one snapshot buffer"
+    buf = next(iter(bufs))
+    assert isinstance(buf, bytes)
+    for i in range(coder.total_shards):
+        p = tree.proof(i)
+        assert isinstance(p.value, memoryview)
+        assert p.value.obj is buf
+        assert p.validate(coder.total_shards)
+    # decode side: unframe recovers the value from the data rows
+    k = coder.data_shards
+    assert _unframe_value(b"".join(bytes(v) for v in leaves[:k])) == value
+
+
+def test_memoryview_proof_wire_roundtrip():
+    """Proof values as memoryviews must encode on the wire identically to
+    their bytes() conversion, and hash/eq-match the bytes form (replay
+    dedup and MultipleValues detection compare Proof objects)."""
+    from hbbft_tpu.ops.merkle import Proof
+    from hbbft_tpu.protocols.broadcast import EchoMsg, ValueMsg
+
+    coder = rs.for_n_f(4, 1)
+    shards, leaves = _encode_value(coder, b"x" * 5000)
+    tree = MerkleTree.from_shards(shards, leaves)
+    for cls in (ValueMsg, EchoMsg):
+        p = tree.proof(2)
+        enc = wire.encode_message(cls(p))
+        pb = Proof(
+            value=bytes(p.value), index=p.index,
+            root_hash=p.root_hash, path=p.path,
+        )
+        assert enc == wire.encode_message(cls(pb))
+        dec = wire.decode_message(enc)
+        assert dec.proof == p and dec.proof == pb
+        assert hash(p) == hash(pb)
+
+
+def test_encode_value_matches_legacy_frame():
+    """The in-place framed encode must produce byte-identical shards to
+    the legacy _frame_value → encode_np pipeline."""
+    from hbbft_tpu.protocols.broadcast import _frame_value
+
+    for n, f in SHAPES:
+        coder = rs.ReedSolomon(n - 2 * f, 2 * f)
+        for vlen in (0, 1, 100, 4097):
+            value = bytes(_rng(vlen + n).integers(0, 256, vlen, dtype=np.uint8))
+            legacy = coder.encode_np(_frame_value(value, coder.data_shards))
+            shards, leaves = _encode_value(coder, value)
+            assert np.array_equal(shards, legacy)
+            assert all(
+                bytes(mv) == bytes(row) for mv, row in zip(leaves, legacy)
+            )
+
+
+def test_rs16_encode_into_matches_encode_np():
+    """GF(2^16) coder (n > 256 networks) honors the same in-place
+    contract — Broadcast._encode_value calls encode_into on ANY coder."""
+    coder = rs.ReedSolomon16(3, 2)
+    data = _rng(99).integers(0, 256, size=(3, 64), dtype=np.uint8)
+    ref = coder.encode_np(data)
+    shards = np.zeros((5, 64), dtype=np.uint8)
+    shards[:3] = data
+    coder.encode_into(shards)
+    assert np.array_equal(shards, ref)
