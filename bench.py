@@ -19,8 +19,13 @@ Configs (BASELINE.md):
             headline metric.
   hb-epoch64 / hb-epoch1024 / hb-epoch4096
             the same full epoch at N=64 / 1024 / 4096 (master-scalar
-            decrypt fold); host baseline extrapolated from N=16; the
-            4096 config (BASELINE config-5 shape) is explicit-only.
+            decrypt fold); host baseline extrapolated from N=16; all
+            hb-epoch* configs shard the whole pipeline over the --mesh /
+            HBBFT_EPOCH_MESH device mesh (auto on multi-device hosts)
+            and record mesh_devices + per-phase attribution.
+  hb-epoch16384
+            first-ever N=16384 full-TPKE epoch — explicit-only and
+            informational (hours-scale; records completion, not a gate).
   acs1024   BASELINE config 4: full ACS at N=1024 (GF(2^16) coder).
   rbc-round one full batched RBC round (N=64) vs object mode.
   rbc64     N=64 f=21 RBC shard pipeline: RS encode + Merkle build,
@@ -588,19 +593,79 @@ def bench_hb_epoch(n: int = 16, tx_bytes: int = 256):
     }, t_dev)
 
 
+def _epoch_mesh(n: int):
+    """The device mesh for the hb-epoch* configs, from ``--mesh`` /
+    ``HBBFT_EPOCH_MESH``:
+
+      auto   mesh over ALL visible devices when there is more than one
+             (1-axis ``("nodes",)``) — the default, so a multi-chip host
+             shards the epoch without any flag;
+      none   force the single-device array path;
+      K      1-axis mesh over the first K devices;
+      AxB    2-axis hierarchical ``("dcn", "ici")`` mesh (hosts × chips)
+             over the first A·B devices.
+
+    Returns None (single-device) or a ``jax.sharding.Mesh``; the node
+    count must divide over the mesh, otherwise falls back to None with a
+    stderr note (the sharded phases shard the node axis evenly)."""
+    import jax
+    from jax.sharding import Mesh
+
+    spec = os.environ.get("HBBFT_EPOCH_MESH", "auto").strip().lower()
+    devs = jax.devices()
+    if spec in ("none", "0", "1", ""):
+        return None
+    if spec == "auto":
+        if len(devs) <= 1:
+            return None
+        shape, axes = (len(devs),), ("nodes",)
+    elif "x" in spec:
+        a, b = (int(p) for p in spec.split("x", 1))
+        shape, axes = (a, b), ("dcn", "ici")
+    else:
+        shape, axes = (int(spec),), ("nodes",)
+    total = int(np.prod(shape))
+    if total > len(devs):
+        raise ValueError(
+            f"HBBFT_EPOCH_MESH={spec!r} wants {total} devices, "
+            f"have {len(devs)}")
+    if total <= 1:
+        return None
+    if n % total:
+        print(f"# mesh {spec!r}: {total} devices do not divide N={n}; "
+              f"falling back to single-device", file=sys.stderr)
+        return None
+    return Mesh(np.array(devs[:total]).reshape(shape), axes)
+
+
+def _mesh_fields(mesh):
+    """The bench-record fields describing the attached mesh — recorded on
+    every hb-epoch* line so ``--compare`` can refuse to gate a sharded
+    run against an unsharded one (the equal-pipeline-depth rule's
+    sibling: throughput across different device counts measures
+    different hardware, not a regression)."""
+    if mesh is None:
+        return {"mesh_devices": 1}
+    return {
+        "mesh_devices": int(np.prod(np.asarray(mesh.devices.shape))),
+        "mesh_axes": "x".join(
+            f"{name}={size}" for name, size in
+            zip(mesh.axis_names, mesh.devices.shape)
+        ),
+    }
+
+
 def _bench_hb_epoch_large(n: int, tx_bytes: int, iters: int, tag: str):
     """A FULL TPKE HoneyBadger epoch at scale — encryption, batched ACS,
     threshold coins, and master-scalar-folded decryption of all accepted
-    ciphertexts.  Host baseline extrapolated from the N=16 object-mode
-    epoch (message count scales ~N³)."""
+    ciphertexts, the whole pipeline node-axis-sharded over the
+    ``--mesh`` device mesh when one resolves (auto on any multi-device
+    host).  Host baseline extrapolated from the N=16 object-mode epoch
+    (message count scales ~N³)."""
     import random
 
     from hbbft_tpu.netinfo import NetworkInfo
     from hbbft_tpu.parallel.acs import BatchedHoneyBadgerEpoch
-    from hbbft_tpu.protocols.honey_badger import (
-        Batch, EncryptionSchedule, HoneyBadger,
-    )
-    from hbbft_tpu.sim import NetBuilder, NullAdversary
 
     rng = random.Random(23)
     print(f"# {tag}: generating keys for N={n}…", file=sys.stderr)
@@ -608,15 +673,30 @@ def _bench_hb_epoch_large(n: int, tx_bytes: int, iters: int, tag: str):
     contribs = {
         i: bytes(rng.randrange(256) for _ in range(tx_bytes)) for i in range(n)
     }
+    mesh = _epoch_mesh(n)
+    if mesh is not None:
+        print(f"# {tag}: epoch sharded over "
+              f"{_mesh_fields(mesh)['mesh_axes']}", file=sys.stderr)
     hb = BatchedHoneyBadgerEpoch(infos, session_id=tag.encode(),
-                                 compact=True)
+                                 compact=True, mesh=mesh)
     batch0, _ = hb.run(contribs, random.Random(1), encrypt=True)  # compile
     assert batch0 == contribs
     times = []
+    phase = {"encrypt": [], "acs": [], "decrypt": []}
     for i in range(iters):
         t0 = time.perf_counter()
-        batch, _ = hb.run(contribs, random.Random(2 + i), encrypt=True)
+        # split the epoch at the phase seams so the record attributes
+        # device time: encrypt (host asm or mesh-routed MSM), then
+        # run_from_payloads' own timer splits acs vs decrypt
+        payloads = hb.encrypt_phase(contribs, random.Random(2 + i))
+        t1 = time.perf_counter()
+        batch, out = hb.run_from_payloads(
+            payloads, encrypt=True, timer=time.perf_counter
+        )
         times.append(time.perf_counter() - t0)
+        phase["encrypt"].append(t1 - t0)
+        phase["acs"].append(out["phase_s"]["acs"])
+        phase["decrypt"].append(out["phase_s"]["decrypt"])
         assert batch == contribs
     t_dev = float(np.median(times))
 
@@ -675,8 +755,12 @@ def _bench_hb_epoch_large(n: int, tx_bytes: int, iters: int, tag: str):
         "unit": "epochs/s",
         "vs_baseline": round(t_host / t_dev, 1),
         "t_device_s": round(t_dev, 4),
+        "phase_s": {
+            ph: round(float(np.median(ts)), 4) for ph, ts in phase.items()
+        },
         "host_note": host_note,
         "shape": f"N={n} f={(n - 1) // 3} tx={tx_bytes}B",
+        **_mesh_fields(mesh),
     }
     if extrapolated:
         out["t_host_est_s"] = round(t_host, 1)
@@ -721,8 +805,23 @@ def bench_hb_epoch4096():
     """Full TPKE HoneyBadger epoch at the BASELINE config-5 shape
     (N=4096 f=1365).  ~3 min first-run compile and ~40 s per epoch — runs
     LAST in --config all so a driver timeout preserves every other config
-    (the emit path marks interrupted runs)."""
+    (the emit path marks interrupted runs).  On a multi-chip host the
+    whole pipeline runs mesh-sharded (``--mesh``, auto) — the ≥1 epoch/s
+    target shape."""
     return _bench_hb_epoch_large(4096, 64, iters=1, tag="hb-epoch4096")
+
+
+def bench_hb_epoch16384():
+    """First-ever N=16384 full-TPKE epoch (f=5461, GF(2^16) coder).
+
+    Explicit-only and informational: the RS16 systematic-matrix
+    construction alone is hours of host time on first run (then disk-
+    cached, ~180 MB), a single epoch is minutes even mesh-sharded, and
+    there is no host baseline at this scale that isn't pure
+    extrapolation — the config exists to RECORD that the shape completes
+    end-to-end (encrypt → sharded ACS → threshold decrypt), not to gate
+    on its throughput.  Never part of ``--config all``."""
+    return _bench_hb_epoch_large(16384, 32, iters=1, tag="hb-epoch16384")
 
 
 def bench_acs1024(n: int = 1024):
@@ -816,15 +915,47 @@ def _rbc_mb1_legacy_once(coder, value: bytes) -> bytes:
     return digs[0]
 
 
+def _rbc_mb1_survivors(coder, value: bytes):
+    """The reconstruct measurement's shared inputs: full shard set for
+    one framed value, worst-case survivor pattern (all-parity-heavy)."""
+    from hbbft_tpu.protocols.broadcast import _frame_value
+
+    framed = _frame_value(value, coder.data_shards)
+    full = coder.encode_np(framed)
+    use = tuple(range(coder.total_shards - coder.data_shards,
+                      coder.total_shards))
+    return full[list(use)], use
+
+
+def _rbc_mb1_legacy_reconstruct_once(coder, survivors, use):
+    """The pre-cache receiver decode, reproduced verbatim: a fresh
+    Gauss–Jordan inversion of the survivor rows on EVERY call, then the
+    GF table-lookup matmul — the decode-side twin of
+    :func:`_rbc_mb1_legacy_once`, and the frozen ``vs_baseline``
+    denominator for rbc_mb1_reconstruct.  Returns the data shards so
+    callers can pin new == legacy."""
+    from hbbft_tpu.ops import gf256
+
+    dec = gf256.gf_inv_matrix_np(coder.matrix[list(use)])
+    return gf256.gf_matmul_np(dec, survivors)
+
+
 def bench_rbc_mb1(n: int = 4, f: int = 1, value_bytes: int = 2**20):
-    """MB-scale proposer hot path: encode + Merkle-commit ONE 1 MiB
-    contribution at N=4 (the ingestion PR's headline shape).  The new
-    path is the live ``_encode_value`` → ``MerkleTree.from_shards``
-    pipeline (cached XOR-schedule / SIMD erasure, batched leaf hashing,
-    one snapshot, zero per-leaf copies); the baseline is the legacy
-    frame → table-matmul → per-shard-copy → scalar-hash pipeline,
-    frozen by ``--freeze-baselines`` so the ratio divides by a fixed
-    measurement."""
+    """MB-scale RBC hot paths at N=4 (the ingestion PR's headline shape),
+    TWO records:
+
+    - ``rbc_mb1_encode_commit`` — proposer side: the live
+      ``_encode_value`` → ``MerkleTree.from_shards`` pipeline (cached
+      XOR-schedule / SIMD erasure, batched leaf hashing, one snapshot,
+      zero per-leaf copies) vs the legacy frame → table-matmul →
+      per-shard-copy → scalar-hash pipeline;
+    - ``rbc_mb1_reconstruct`` — receiver side: the pattern-cached decode
+      (LRU'd Gauss–Jordan inversion + compiled XOR-schedule apply, the
+      decode-side gap ROADMAP item 2 named) vs the legacy per-call
+      inversion + table matmul.
+
+    Both baselines are frozen by ``--freeze-baselines`` so the ratios
+    divide by fixed measurements."""
     from hbbft_tpu.ops.merkle import MerkleTree
     from hbbft_tpu.ops.rs import resolve_backend
     from hbbft_tpu.protocols.broadcast import _encode_value
@@ -843,7 +974,7 @@ def bench_rbc_mb1(n: int = 4, f: int = 1, value_bytes: int = 2**20):
     t_new = _timeit_best(new_once, warmup=2, iters=5, min_time=0.1)
     t_host = _timeit_best(lambda: _rbc_mb1_legacy_once(coder, value),
                           reps=3, warmup=1, iters=3, min_time=0.1)
-    return _apply_frozen({
+    encode_rec = _apply_frozen({
         "metric": "rbc_mb1_encode_commit",
         "value": round(value_bytes / 2**20 / t_new, 2),
         "unit": "MB/s",
@@ -853,6 +984,31 @@ def bench_rbc_mb1(n: int = 4, f: int = 1, value_bytes: int = 2**20):
         "erasure_backend": resolve_backend(),
         "shape": f"N={n} f={f} value={value_bytes}B",
     }, t_new)
+
+    survivors, use = _rbc_mb1_survivors(coder, value)
+    # correctness pin: cached decode == legacy per-call decode, bytewise
+    got = coder.reconstruct_data_np(survivors, use)
+    legacy = _rbc_mb1_legacy_reconstruct_once(coder, survivors, use)
+    np.testing.assert_array_equal(got, legacy)
+
+    t_rec = _timeit_best(
+        lambda: coder.reconstruct_data_np(survivors, use),
+        warmup=2, iters=5, min_time=0.1)
+    t_rec_host = _timeit_best(
+        lambda: _rbc_mb1_legacy_reconstruct_once(coder, survivors, use),
+        reps=3, warmup=1, iters=3, min_time=0.1)
+    out_bytes = coder.data_shards * survivors.shape[1]
+    recon_rec = _apply_frozen({
+        "metric": "rbc_mb1_reconstruct",
+        "value": round(out_bytes / 2**20 / t_rec, 2),
+        "unit": "MB/s",
+        "vs_baseline": round(t_rec_host / t_rec, 2),
+        "t_new_s": round(t_rec, 6),
+        "t_host_s": round(t_rec_host, 6),
+        "erasure_backend": resolve_backend(),
+        "shape": f"N={n} f={f} value={value_bytes}B worst-case survivors",
+    }, t_rec)
+    return [encode_rec, recon_rec]
 
 
 # Ordered so an interrupted driver run keeps the BASELINE configs: the
@@ -873,7 +1029,12 @@ CONFIGS = {
     "sha3": bench_sha3,
     "dkg256": bench_dkg256,
     "hb-epoch4096": bench_hb_epoch4096,
+    "hb-epoch16384": bench_hb_epoch16384,
 }
+
+# explicit-only configs: runnable via --config NAME but never part of
+# --config all (hours-scale informational shapes)
+EXPLICIT_ONLY = ("hb-epoch16384",)
 
 
 def freeze_baselines():
@@ -977,6 +1138,16 @@ def freeze_baselines():
         "legacy frame + table-matmul encode + per-shard copy + "
         "scalar-hash Merkle build (pre-ingestion proposer pipeline; "
         "best-of-5 _timeit, same estimator as the live side)")
+
+    survivors, use = _rbc_mb1_survivors(coder, value)
+    rec("rbc_mb1_reconstruct",
+        _timeit_best(
+            lambda: _rbc_mb1_legacy_reconstruct_once(coder, survivors, use),
+            warmup=1, iters=3, min_time=0.1),
+        "N=4 f=1 value=1MiB worst-case survivors",
+        "legacy per-call Gauss-Jordan inversion + table-matmul decode "
+        "(pre-cache receiver pipeline; best-of-5 _timeit, same "
+        "estimator as the live side)")
 
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BASELINE_MEASURED.json")
@@ -1753,10 +1924,18 @@ def compare_bench(old, new, threshold: float = 0.15,
         })
 
     unit = str(old.get("unit", ""))
+    # Mesh-equality rule (the equal-pipeline-depth rule's sibling): an
+    # hb-epoch* record carries mesh_devices, and throughput across
+    # different device counts measures different hardware — an 8-chip
+    # recording must not read a single-chip rerun as an 87% regression.
+    # Unequal meshes skip the headline value gate; records without the
+    # field (every non-epoch config) default to 1 == 1 and gate normally.
+    meshes_match = old.get("mesh_devices", 1) == new.get("mesh_devices", 1)
     # rates and the chaos campaign's clean fraction are higher-better;
     # latencies/durations below are lower-better
-    add("value", unit.endswith("/s") or unit == "clean_fraction",
-        threshold)
+    if meshes_match:
+        add("value", unit.endswith("/s") or unit == "clean_fraction",
+            threshold)
     for lat in ("p50_latency_ms", "p99_latency_ms"):
         add(lat, False, threshold)
     # Per-EPOCH duration metrics (epoch wall, phase attribution) compare
@@ -1800,6 +1979,32 @@ def compare_bench(old, new, threshold: float = 0.15,
                 "threshold_pct": round(100 * threshold, 2),
                 "regressed": -delta > threshold,
             })
+    # MULTICHIP trajectory (dryrun_multichip's emitted record): per
+    # device-count epochs/s is a higher-better rate, gated only at equal
+    # n_devices — like the chaos campaign's clean_fraction, dropping a
+    # device count from the sweep contributes nothing to the verdict
+    def traj_map(doc):
+        return {
+            e.get("n_devices"): e
+            for e in doc.get("trajectory", ()) if isinstance(e, dict)
+        }
+
+    old_traj, new_traj = traj_map(old), traj_map(new)
+    for nd in sorted(k for k in old_traj if k in new_traj):
+        o, nv = (old_traj[nd].get("epochs_per_s"),
+                 new_traj[nd].get("epochs_per_s"))
+        if not isinstance(o, (int, float)) \
+                or not isinstance(nv, (int, float)) or o <= 0:
+            continue
+        delta = (nv - o) / o
+        checks.append({
+            "name": f"trajectory[{nd}dev].epochs_per_s",
+            "old": o,
+            "new": nv,
+            "delta_pct": round(100 * delta, 2),
+            "threshold_pct": round(100 * threshold, 2),
+            "regressed": -delta > threshold,
+        })
     regressions = [c["name"] for c in checks if c["regressed"]]
     return {
         "metric": "bench_compare",
@@ -1808,6 +2013,7 @@ def compare_bench(old, new, threshold: float = 0.15,
         "ok": not regressions,
         "regressions": regressions,
         "epoch_metrics_compared": depths_match,
+        "mesh_metrics_compared": meshes_match,
         "checks": checks,
     }
 
@@ -1864,6 +2070,13 @@ def main(argv=None):
              "and MB/s under ingest_sweep)",
     )
     ap.add_argument(
+        "--mesh", default="", metavar="auto|none|K|AxB",
+        help="device mesh for the hb-epoch* configs (sets "
+             "HBBFT_EPOCH_MESH): 'auto' shards over all devices when >1 "
+             "(default), 'none' forces single-device, 'K' a 1-axis mesh "
+             "over K devices, 'AxB' a 2-axis (dcn,ici) hierarchical mesh",
+    )
+    ap.add_argument(
         "--freeze-baselines", action="store_true",
         help="measure the HOST side of the non-headline configs and "
         "record them in BASELINE_MEASURED.json as the fixed vs_baseline "
@@ -1915,7 +2128,11 @@ def main(argv=None):
         sustained4096(args.sustained)
         return
 
-    names = list(CONFIGS) if args.config == "all" else [args.config]
+    if args.mesh:
+        os.environ["HBBFT_EPOCH_MESH"] = args.mesh
+
+    names = ([c for c in CONFIGS if c not in EXPLICIT_ONLY]
+             if args.config == "all" else [args.config])
     results = []
     failed = []
     emitted = False
@@ -2001,10 +2218,13 @@ def main(argv=None):
                 print(f"# {name} FAILED: {exc!r}", file=sys.stderr)
                 failed.append(name)
                 continue
-            r["device"] = device.device_kind
-            r["config_name"] = name
-            print(f"# {json.dumps(r)}", file=sys.stderr)
-            results.append(r)
+            # a config may return several records (rbc-mb1 emits its
+            # encode and reconstruct measurements as separate metrics)
+            for rec in r if isinstance(r, list) else [r]:
+                rec["device"] = device.device_kind
+                rec["config_name"] = name
+                print(f"# {json.dumps(rec)}", file=sys.stderr)
+                results.append(rec)
     except BaseException as exc:
         # a harness/setup crash must be distinguishable from a clean
         # zero-result run in the emitted line; the re-raise keeps the

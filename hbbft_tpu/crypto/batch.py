@@ -159,7 +159,8 @@ class _MsmCache:
                     return pack(flat, oinf)
 
             if self.mesh is not None and size % self.mesh.devices.size == 0:
-                from jax import shard_map
+                from hbbft_tpu.util import shard_map_compat
+                shard_map = shard_map_compat()
                 from jax.sharding import PartitionSpec as P
 
                 axes = tuple(self.mesh.axis_names)
@@ -341,9 +342,52 @@ def use_mesh(mesh) -> None:
     Caches are kept per mesh, so toggling back and forth never re-pays
     ladder compiles (minutes each on the CPU backend)."""
     global _CACHE
+    _CACHE = cache_for(mesh)
+
+
+def cache_for(mesh) -> _MsmCache:
+    """The per-mesh ladder cache (created on first use, then reused).
+
+    The explicit-cache route for callers that hold a mesh of their own —
+    the sharded verify/decrypt entry points in :mod:`hbbft_tpu.parallel.
+    mesh` pin the cache returned here instead of reading the module-global
+    ``_CACHE``, so an epoch driver's mesh and the crypto cache's mesh are
+    one object and can never disagree."""
     if mesh not in _CACHES:
         _CACHES[mesh] = _MsmCache(mesh=mesh)
-    _CACHE = _CACHES[mesh]
+    return _CACHES[mesh]
+
+
+def current_mesh():
+    """The mesh the module-global entry points currently route through
+    (``None`` = single-device).  Benches record this next to their
+    results so ``--compare`` only gates equal-mesh runs."""
+    return _CACHE.mesh
+
+
+class routed_mesh:
+    """Scope-bound :func:`use_mesh`: route the module-global MSM entry
+    points through ``mesh`` inside the ``with`` block, restoring the
+    previous routing on exit.  The epoch driver wraps its crypto phases
+    in this so the mesh handed to ``BatchedHoneyBadgerEpoch(mesh=...)``
+    and the mesh consulted by :func:`device_encrypt_worthwhile` are the
+    same object — the two could previously be set independently and
+    disagree.  Re-entrant; a no-op when ``mesh`` is already routed."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self._prev = None
+
+    def __enter__(self):
+        global _CACHE
+        self._prev = _CACHE
+        _CACHE = cache_for(self.mesh)
+        return _CACHE
+
+    def __exit__(self, *exc):
+        global _CACHE
+        _CACHE = self._prev
+        return False
 
 
 # --------------------------------------------------------------------------
@@ -383,7 +427,7 @@ def _master_for(pks, items) -> int:
     return master
 
 
-def batch_tpke_decrypt(pks, cts, secret_shares):
+def batch_tpke_decrypt(pks, cts, secret_shares, cache=None):
     """God-view batched TPKE decryption of many ciphertexts at once.
 
     ``secret_shares``: (index, SecretKeyShare) pairs, ≥ t+1 of them (the
@@ -395,6 +439,9 @@ def batch_tpke_decrypt(pks, cts, secret_shares):
     The same documented god-view shortcut as the simulator's once-per-
     proposer decryption (per-node share traffic/verification is the cost
     model's business).  Returns the plaintext list, aligned with ``cts``.
+
+    ``cache``: an explicit :class:`_MsmCache` (from :func:`cache_for`) for
+    mesh-pinned callers; defaults to the module-global routing.
     """
     from hbbft_tpu.crypto import tc
 
@@ -406,7 +453,7 @@ def batch_tpke_decrypt(pks, cts, secret_shares):
         return []
     master = _master_for(pks, items)
     if _device_worthwhile(len(cts), DEVICE_DECRYPT_MIN_BATCH):
-        masks = _CACHE.g1_mul_batch(
+        masks = (_CACHE if cache is None else cache).g1_mul_batch(
             [ct.u for ct in cts], [master] * len(cts)
         )
         mask_bytes = [c.g1_to_bytes(m) for m in masks]
@@ -430,7 +477,7 @@ def batch_tpke_decrypt(pks, cts, secret_shares):
     return out
 
 
-def batch_tpke_check_decrypt(pks, payloads, secret_shares):
+def batch_tpke_check_decrypt(pks, payloads, secret_shares, cache=None):
     """Wire-validate + decrypt raw ciphertext payload bytes in one pass —
     the HoneyBadger epoch's parse phase (``Ciphertext.from_bytes`` per
     accepted proposer: canonical/on-curve/subgroup checks for U and W)
@@ -469,13 +516,15 @@ def batch_tpke_check_decrypt(pks, payloads, secret_shares):
                 out[i] = pt
             rest = [i for i in range(len(payloads)) if out[i] is None]
             cts = [tc.Ciphertext.from_bytes(payloads[i]) for i in rest]
-            for i, pt in zip(rest, batch_tpke_decrypt(pks, cts, secret_shares)):
+            for i, pt in zip(
+                rest, batch_tpke_decrypt(pks, cts, secret_shares, cache=cache)
+            ):
                 out[i] = pt
             return out
     # ground-truth path: per-item parse (raises with the precise error on
     # the first malformed payload), then the batched decrypt
     cts = [tc.Ciphertext.from_bytes(p) for p in payloads]
-    return batch_tpke_decrypt(pks, cts, secret_shares)
+    return batch_tpke_decrypt(pks, cts, secret_shares, cache=cache)
 
 
 # --------------------------------------------------------------------------
@@ -849,17 +898,18 @@ def _fs_scalars(seed: bytes, n: int, offset: int = 0):
     ]
 
 
-def batch_decrypt_share_gen(secret_scalar: int, cts):
+def batch_decrypt_share_gen(secret_scalar: int, cts, cache=None):
     """One node's decryption shares ``x_i·U_p`` for many ciphertexts in a
     single call (same scalar, many bases).  Value-identical to per-item
     ``SecretKeyShare.decrypt_share(ct, check=False)``; the device ladder
-    engages above the decrypt crossover, the native asm below it."""
+    engages above the decrypt crossover, the native asm below it.
+    ``cache`` as in :func:`batch_verify_sig_shares`."""
     from hbbft_tpu.crypto import tc
 
     if not cts:
         return []
     if _device_worthwhile(len(cts), DEVICE_DECRYPT_MIN_BATCH):
-        pts = _CACHE.g1_mul_batch(
+        pts = (_CACHE if cache is None else cache).g1_mul_batch(
             [ct.u for ct in cts], [secret_scalar] * len(cts)
         )
         return [tc.DecryptionShare(p) for p in pts]
@@ -951,21 +1001,26 @@ def batch_verify_sig_shares(
     pairs: Sequence[Tuple[object, object]],
     msg: bytes,
     rng: random.Random,
+    cache=None,
 ) -> bool:
     """All-or-nothing check of (PublicKeyShare, SignatureShare) pairs.
 
     True ⟹ every share is valid.  False ⟹ at least one share is invalid
     (caller falls back to per-share verification for blame).
+
+    ``cache``: an explicit per-mesh :class:`_MsmCache` (see
+    :func:`cache_for`); default is the module-global routing.
     """
     if not pairs:
         return True
+    cc = _CACHE if cache is None else cache
     rs = [rng.getrandbits(_RAND_BITS) | 1 for _ in pairs]
     # dispatch both ladders before collecting either — they overlap on
     # the device
-    h_sig = _CACHE._msm_dispatch("g2", [s.point for _, s in pairs], rs)
-    h_pk = _CACHE._msm_dispatch("g1", [p.point for p, _ in pairs], rs)
-    sig_comb = _CACHE._msm_collect(h_sig)
-    pk_comb = _CACHE._msm_collect(h_pk)
+    h_sig = cc._msm_dispatch("g2", [s.point for _, s in pairs], rs)
+    h_pk = cc._msm_dispatch("g1", [p.point for p, _ in pairs], rs)
+    sig_comb = cc._msm_collect(h_sig)
+    pk_comb = cc._msm_collect(h_pk)
     h = c.hash_g2(msg)
     if sig_comb is None or pk_comb is None:
         # Σ rᵢσᵢ = ∞ happens only if shares are invalid (or all inputs ∞)
@@ -979,18 +1034,21 @@ def batch_verify_dec_shares(
     pairs: Sequence[Tuple[object, object]],
     ct,
     rng: random.Random,
+    cache=None,
 ) -> bool:
     """All-or-nothing check of (PublicKeyShare, DecryptionShare) pairs
-    against a TPKE ciphertext (U, V, W)."""
+    against a TPKE ciphertext (U, V, W).  ``cache`` as in
+    :func:`batch_verify_sig_shares`."""
     if not pairs:
         return True
     from hbbft_tpu.crypto.tc import _hash_ciphertext_point
 
+    cc = _CACHE if cache is None else cache
     rs = [rng.getrandbits(_RAND_BITS) | 1 for _ in pairs]
-    h_d = _CACHE._msm_dispatch("g1", [d.point for _, d in pairs], rs)
-    h_pk = _CACHE._msm_dispatch("g1", [p.point for p, _ in pairs], rs)
-    d_comb = _CACHE._msm_collect(h_d)
-    pk_comb = _CACHE._msm_collect(h_pk)
+    h_d = cc._msm_dispatch("g1", [d.point for _, d in pairs], rs)
+    h_pk = cc._msm_dispatch("g1", [p.point for p, _ in pairs], rs)
+    d_comb = cc._msm_collect(h_d)
+    pk_comb = cc._msm_collect(h_pk)
     h = _hash_ciphertext_point(ct.u, ct.v)
     if d_comb is None or pk_comb is None:
         return d_comb is None and pk_comb is None

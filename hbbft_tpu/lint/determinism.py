@@ -121,6 +121,11 @@ class DeterminismChecker(Checker):
     # path: its per-backend STATS counters must stay plain ints (no
     # clocks) — all three backends must produce byte-identical parity,
     # and nondeterminism here forks the Merkle commitment
+    # parallel/ includes parallel/mesh.py: the sharded epoch wrappers and
+    # their per-phase STATS counters are subject to the same rule — a
+    # mesh run and a single-device run must stay bit-identical, so the
+    # collective accounting is computed statically from shapes, never
+    # from clocks or traced values
     scope = ("hbbft_tpu/protocols/", "hbbft_tpu/parallel/",
              "hbbft_tpu/crypto/", "hbbft_tpu/chaos/",
              "hbbft_tpu/ops/rs.py")

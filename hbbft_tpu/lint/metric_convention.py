@@ -5,7 +5,7 @@ The PR-3 static pass, rehosted on the lint framework (the repo-root
 output).  Three invariants over the package + ``bench.py``:
 
 - every registered metric name follows
-  ``hbbft_<net|node|phase|sim|obs|chaos|sync|guard>_<name>``;
+  ``hbbft_<net|node|phase|sim|obs|chaos|sync|guard|rbc|load|mesh>_<name>``;
 - every registered metric name is documented in README.md's Observability
   section;
 - every :class:`~hbbft_tpu.fault_log.FaultKind` variant has a
@@ -24,7 +24,7 @@ from typing import Iterable, List, Optional, Tuple
 from hbbft_tpu.lint.core import Checker, Finding, Project, register
 
 NAME_CONVENTION = re.compile(
-    r"^hbbft_(net|node|phase|sim|obs|chaos|sync|guard|rbc|load)"
+    r"^hbbft_(net|node|phase|sim|obs|chaos|sync|guard|rbc|load|mesh)"
     r"_[a-z][a-z0-9_]*$"
 )
 

@@ -58,6 +58,7 @@ from hbbft_tpu.obs.http import ObsServer
 from hbbft_tpu.obs.metrics import MetricAttr, Registry, fault_counter
 from hbbft_tpu.obs.spans import SpanTracer
 from hbbft_tpu.ops import rs as _rs
+from hbbft_tpu.parallel import mesh as _mesh
 from hbbft_tpu.protocols import wire
 from hbbft_tpu.protocols.dynamic_honey_badger import (
     DhbBatch,
@@ -230,6 +231,24 @@ class NodeRuntime:
             "payload bytes through the erasure hot path by backend",
             labelnames=("backend",), max_label_sets=4)
         self._rs_stats_last = _rs.stats_snapshot()
+        # hbbft_mesh_*: device-mesh collective accounting for the sharded
+        # epoch phases (parallel/mesh.py keeps the same deterministic
+        # plain-int counters as ops/rs.py; deltas fold here per scrape).
+        # Zero on single-device runs — nonzero only when a node runs the
+        # mesh-sharded epoch path.
+        self._c_mesh_coll = self.registry.counter(
+            "hbbft_mesh_collectives_total",
+            "mesh-spanning collective launches by sharded epoch phase",
+            labelnames=("phase",), max_label_sets=5)
+        self._c_mesh_bytes = self.registry.counter(
+            "hbbft_mesh_gather_bytes_total",
+            "bytes returned by sharded-phase collectives (computed "
+            "statically from array shapes, not traced)",
+            labelnames=("phase",), max_label_sets=5)
+        for ph in ("rbc", "aba", "coin", "decrypt"):
+            self._c_mesh_coll.labels(phase=ph)
+            self._c_mesh_bytes.labels(phase=ph)
+        self._mesh_stats_last = _mesh.stats_snapshot()
         self.registry.register_callback(self._refresh_gauges)
         # `is not None`, not `or`: Mempool defines __len__, so a freshly
         # configured (empty → falsy) instance would be silently replaced
@@ -409,6 +428,15 @@ class NodeRuntime:
             if d_bytes > 0:
                 self._c_rbc_bytes.labels(backend=backend).inc(d_bytes)
             self._rs_stats_last[backend] = dict(cur)
+        for ph, cur in _mesh.stats_snapshot().items():
+            last = self._mesh_stats_last.get(ph, {})
+            d_coll = cur["collectives"] - last.get("collectives", 0)
+            d_bytes = cur["gather_bytes"] - last.get("gather_bytes", 0)
+            if d_coll > 0:
+                self._c_mesh_coll.labels(phase=ph).inc(d_coll)
+            if d_bytes > 0:
+                self._c_mesh_bytes.labels(phase=ph).inc(d_bytes)
+            self._mesh_stats_last[ph] = dict(cur)
         era, epoch = self.current_key()
         r.gauge("hbbft_node_era", "current consensus era").set(era)
         r.gauge("hbbft_node_epoch", "current epoch within the era").set(epoch)

@@ -106,7 +106,15 @@ def aux_pref_from_crossings(x, inf):
 
 
 class BatchedAba:
-    """Batched ABA epochs for an (n, f) network, P instances."""
+    """Batched ABA epochs for an (n, f) network, P instances.
+
+    Multi-chip: :func:`hbbft_tpu.parallel.mesh.make_sharded_aba_step`
+    wraps :meth:`epoch_step` with the node-state rows sharded over a
+    device mesh (bit-equal — tier-1 asserts it); the coin helpers below
+    stay replicated — one ``bls_coin_batch`` native call per random
+    epoch covers the whole instance axis and is noise next to the
+    sharded exchanges, so there is nothing to shard in them.
+    """
 
     def __init__(self, n: int, f: int):
         self.n = n

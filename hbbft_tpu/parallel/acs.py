@@ -39,9 +39,18 @@ class BatchedAcs:
         self._build_runners()
 
     def __getstate__(self):
-        """Snapshot support: jit handles rebuild on restore.  Mesh-sharded
-        instances refuse to pickle — a ``Mesh`` is bound to live devices;
-        snapshot the unsharded driver and re-attach the mesh on restore."""
+        """Snapshot support: jit handles rebuild on restore.
+
+        Mesh-sharded instances refuse to pickle — a ``Mesh`` is bound to
+        live devices of THIS process, so a pickled one could never restore
+        elsewhere.  The supported path is reconstruct-from-unsharded:
+        snapshot a ``mesh=None`` driver (state-sync snapshots already do —
+        ``net/statesync.py`` ships protocol state, never device placement),
+        then build a fresh ``BatchedAcs(n, f, mesh=mesh)`` /
+        ``BatchedHoneyBadgerEpoch(..., mesh=mesh)`` on the restoring host
+        and replay into it; results are bit-identical to the sharded
+        original (tests/test_parallel_mesh.py asserts mesh/single
+        equality), so nothing is lost by snapshotting unsharded."""
         if self.mesh is not None:
             raise TypeError(
                 "cannot snapshot a mesh-sharded BatchedAcs; snapshot the "
@@ -257,7 +266,34 @@ class BatchedHoneyBadgerEpoch:
         # the epoch drivers at scale enable it; the default keeps the full
         # detail arrays that cross-mode equality tests compare
         self.compact = compact
+        # ONE mesh threads the whole epoch: the protocol rounds (BatchedAcs
+        # → sharded RBC/ABA below) and the crypto ladders (the sharded
+        # verify/decrypt makers pin crypto.batch.cache_for(mesh), and
+        # encrypt_phase scopes crypto.batch.routed_mesh(mesh) around its
+        # backend routing) all see the same object — use_mesh and the
+        # epoch driver's mesh= used to be set independently and could
+        # disagree.
+        self.mesh = mesh
         self.acs = BatchedAcs(self.n, self.f, mesh=mesh)
+        if mesh is not None:
+            from hbbft_tpu.parallel.mesh import (
+                make_sharded_coin_verify,
+                make_sharded_decrypt,
+            )
+
+            # mesh-routed share verification for callers that check coin
+            # shares around this epoch (bench/verification flows) — the
+            # god-view epoch itself derives coins from the master scalar
+            self.coin_verify = make_sharded_coin_verify(mesh)
+            self._check_decrypt = make_sharded_decrypt(mesh)
+        else:
+            from hbbft_tpu.crypto.batch import (
+                batch_tpke_check_decrypt,
+                batch_verify_sig_shares,
+            )
+
+            self.coin_verify = batch_verify_sig_shares
+            self._check_decrypt = batch_tpke_check_decrypt
 
     def encrypt_phase(self, contributions: Dict, rng,
                       encrypt: bool = True) -> List[bytes]:
@@ -277,16 +313,22 @@ class BatchedHoneyBadgerEpoch:
         amortized fixed-base tables + a single GIL release), or the SPLIT
         device path — all proposers' G1/G2 ladders as device MSM
         dispatches chunk-pipelined against the native hash-to-G2 batch —
-        when a mesh is attached; HBBFT_ENCRYPT_BACKEND overrides."""
+        when a mesh is attached; HBBFT_ENCRYPT_BACKEND overrides.  The
+        roofline consults THIS epoch's mesh: the routing runs inside
+        ``crypto.batch.routed_mesh(self.mesh)``, so the device path's
+        row-sharding and the ACS sharding ride one mesh."""
+        from hbbft_tpu.crypto import batch as _cb
         from hbbft_tpu.crypto import tc
 
         contribs = [contributions.get(nid, b"") for nid in self.ids]
         if not encrypt:
             return contribs
         pk = self.netinfo_map[self.ids[0]].public_key_set().public_key()
-        return [
-            ct.to_bytes() for ct in tc.tpke_encrypt_batch(pk, contribs, rng)
-        ]
+        with _cb.routed_mesh(self.mesh):
+            return [
+                ct.to_bytes()
+                for ct in tc.tpke_encrypt_batch(pk, contribs, rng)
+            ]
 
     def run(self, contributions: Dict, rng, encrypt: bool = True,
             session_suffix: bytes = b"", **rbc_kwargs):
@@ -306,9 +348,16 @@ class BatchedHoneyBadgerEpoch:
         )
 
     def run_from_payloads(self, payloads, encrypt: bool = True,
-                          session_suffix: bytes = b"", **rbc_kwargs):
+                          session_suffix: bytes = b"", timer=None,
+                          **rbc_kwargs):
         """ACS + threshold-decrypt over pre-encrypted payloads (see
-        :meth:`encrypt_phase`)."""
+        :meth:`encrypt_phase`).
+
+        ``timer``: optional zero-arg clock (e.g. ``time.perf_counter``)
+        injected by benches for per-phase attribution — when set, the
+        detail dict gains ``phase_s = {"acs": ..., "decrypt": ...}``.
+        Injected rather than read here so this module stays clock-free
+        (hblint determinism scope)."""
         info0 = self.netinfo_map[self.ids[0]]
         pks = info0.public_key_set()
         session = self.session_id + session_suffix
@@ -319,10 +368,14 @@ class BatchedHoneyBadgerEpoch:
         def coin_batch_fn(e):
             return coins_for_epoch(self.netinfo_map, session, self.ids, e)
 
+        t0 = timer() if timer is not None else None
         out = self.acs.run(
             payloads, coin_fn=coin_fn, coin_batch_fn=coin_batch_fn,
             compact=self.compact, **rbc_kwargs
         )
+        if timer is not None:
+            out["phase_s"] = {"acs": timer() - t0}
+            t0 = timer()
         # what the RBC actually broadcast (ciphertext bytes when encrypting)
         # — cost models need this, not the plaintext length
         out["payload_bytes"] = max((len(p) for p in payloads), default=0)
@@ -392,9 +445,10 @@ class BatchedHoneyBadgerEpoch:
             # checks (canonical/on-curve/subgroup for U and W) and the
             # master-scalar decrypt run back-to-back in C with the GIL
             # released — at N=4096 this was a ~1 s host loop of Python
-            # bigint parsing on top of the 0.6 s decrypt call
-            from hbbft_tpu.crypto.batch import batch_tpke_check_decrypt
-
+            # bigint parsing on top of the 0.6 s decrypt call.  Routed
+            # through self._check_decrypt: the mesh-pinned sharded entry
+            # point when this epoch carries a mesh, the plain batch call
+            # otherwise (byte-identical results either way).
             shares = [
                 (
                     self.netinfo_map[onid].node_index(onid),
@@ -402,9 +456,11 @@ class BatchedHoneyBadgerEpoch:
                 )
                 for onid in self.ids[: t + 1]
             ]
-            plaintexts = batch_tpke_check_decrypt(
+            plaintexts = self._check_decrypt(
                 pks, [pl for _, pl in pending], shares
             )
             for (nid, _), pt in zip(pending, plaintexts):
                 batch[nid] = pt
+        if timer is not None:
+            out["phase_s"]["decrypt"] = timer() - t0
         return batch, out

@@ -27,6 +27,32 @@ import numpy as np
 
 from hbbft_tpu.parallel.rbc import BatchedRbc
 
+# Deterministic host-side accounting of the sharded wrappers' collective
+# traffic.  Plain ints — this module sits in hblint's determinism scope,
+# so no clocks here; net/runtime.py folds deltas into the hbbft_mesh_*
+# registry counters at scrape time (same pattern as ops/rs.py::STATS →
+# hbbft_rbc_erasure_*).  ``collectives`` counts mesh-spanning collective
+# launches (one all_gather/psum group per mesh axis); ``gather_bytes``
+# counts the bytes those collectives return, computed statically from the
+# array shapes (shard + root payloads for RBC — Merkle proof tensors are
+# excluded, their depth varies per shape; gathered state rows for ABA;
+# affine point bytes for the crypto phases).
+STATS = {
+    ph: {"collectives": 0, "gather_bytes": 0}
+    for ph in ("rbc", "aba", "coin", "decrypt")
+}
+
+
+def stats_snapshot():
+    """Copy of the per-phase mesh-collective counters."""
+    return {ph: dict(v) for ph, v in STATS.items()}
+
+
+def _account(phase: str, collectives: int, gather_bytes: int) -> None:
+    s = STATS[phase]
+    s["collectives"] += int(collectives)
+    s["gather_bytes"] += int(gather_bytes)
+
 
 def _gather_nodes(x, axes):
     """all_gather the leading (node-sharded) axis back to full size —
@@ -46,7 +72,13 @@ def _flat_device_index(axes):
 
     idx = jax.lax.axis_index(axes[0])
     for ax in axes[1:]:
-        idx = idx * jax.lax.axis_size(ax) + jax.lax.axis_index(ax)
+        # axis_size(ax) post-dates the 0.4.x line; psum(1, ax) is the
+        # version-stable spelling (constant-folded by the partitioner)
+        if hasattr(jax.lax, "axis_size"):
+            size = jax.lax.axis_size(ax)
+        else:
+            size = jax.lax.psum(1, ax)
+        idx = idx * size + jax.lax.axis_index(ax)
     return idx
 
 
@@ -66,7 +98,8 @@ def make_sharded_rbc_run(rbc: BatchedRbc, mesh):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from hbbft_tpu.util import shard_map_compat
+    shard_map = shard_map_compat()
 
     n = rbc.n
     axes = tuple(mesh.axis_names)
@@ -118,6 +151,9 @@ def make_sharded_rbc_run(rbc: BatchedRbc, mesh):
     def run(data, codeword_tamper=None, value_tamper=None, value_mask=None,
             echo_mask=None, ready_mask=None):
         P_, k, B = data.shape
+        # three gathers (shards, roots, proofs) per mesh axis; bytes are
+        # the shard + root payloads every device receives
+        _account("rbc", 3 * len(axes), P_ * n * B + P_ * 32)
         if codeword_tamper is None:
             codeword_tamper = jnp.zeros((P_, n, B), dtype=jnp.uint8)
         if value_tamper is None:
@@ -154,7 +190,8 @@ def make_sharded_rbc_large_run(rbc: BatchedRbc, mesh):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from hbbft_tpu.util import shard_map_compat
+    shard_map = shard_map_compat()
 
     n, f, k = rbc.n, rbc.f, rbc.k
     axes = tuple(mesh.axis_names)
@@ -200,6 +237,13 @@ def make_sharded_rbc_large_run(rbc: BatchedRbc, mesh):
 
     def run(data, codeword_tamper=None, value_tamper=None):
         P_ = data.shape[0]
+        # proposer-parallel stages: no cross-proposer collective inside;
+        # the two sharded stage launches re-assemble their per-proposer
+        # verdicts across the mesh once each (counted per axis), and the
+        # bytes that leave each device are its slice of the framed data
+        _account(
+            "rbc", 2 * len(axes), int(np.prod(np.asarray(data.shape)))
+        )
         has_cw = codeword_tamper is not None
         has_vt = value_tamper is not None
         a, b = _stage_fns(P_, has_cw, has_vt)
@@ -243,7 +287,8 @@ def make_sharded_aba_step(aba, mesh):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from hbbft_tpu.util import shard_map_compat
+    shard_map = shard_map_compat()
 
     n, f = aba.n, aba.f
     axes = tuple(mesh.axis_names)
@@ -453,12 +498,27 @@ def make_sharded_aba_step(aba, mesh):
         check_vma=False,
     ))
 
+    # static collective counts per traced step, for the hbbft_mesh_*
+    # accounting: the SBV round-model reductions plus the aux/conf/term
+    # exchanges (6 on the full-delivery path, 6 gathers+psums masked)
+    from hbbft_tpu.parallel.aba import SBV_ROUNDS_FULL, sbv_rounds_masked
+
+    _coll_full = (SBV_ROUNDS_FULL + 6) * len(axes)
+    _coll_masked = (sbv_rounds_masked(n) + 6) * len(axes)
+
     def step(state, coin_bits, bval_mask=None, aux_mask=None, conf_mask=None):
+        P_ = state["est"].shape[1]
         if bval_mask is None and aux_mask is None and conf_mask is None:
+            # psum results are (P,)-shaped int32 reductions
+            _account("aba", _coll_full, (SBV_ROUNDS_FULL + 6) * P_ * 4)
             return fn_full(state, coin_bits)
         import jax.numpy as jnp
 
-        P_ = state["est"].shape[1]
+        # gathered (N, P, 2)-ish bool tensors per round + aux/conf/sent
+        _account(
+            "aba", _coll_masked,
+            (2 * sbv_rounds_masked(n) + 5) * n * P_,
+        )
         eye = jnp.eye(n, dtype=bool)[:, :, None]
         ones = jnp.ones((n, n, P_), dtype=bool)
         bm = ones if bval_mask is None else jnp.asarray(bval_mask) | eye
@@ -467,3 +527,67 @@ def make_sharded_aba_step(aba, mesh):
         return fn_masked(state, coin_bits, bm, am, cm)
 
     return step
+
+
+# ---------------------------------------------------------------------------
+# Sharded crypto phases (coin share verification, threshold decryption)
+# ---------------------------------------------------------------------------
+#
+# The protocol rounds above shard the NODE axis; the crypto phases shard the
+# MSM ROW axis instead (crypto/batch._MsmCache row-shards its ladders when a
+# mesh is attached — row-sharding is collective-free until the final fold).
+# These makers pin the per-mesh ladder cache (crypto.batch.cache_for) at
+# build time, so the mesh an epoch driver threads through BatchedAcs and the
+# mesh the crypto ladders run on are the SAME object — the two used to be
+# set independently (use_mesh vs. BatchedHoneyBadgerEpoch(mesh=...)) and
+# could disagree.
+
+
+def make_sharded_coin_verify(mesh):
+    """Coin/signature share batch verification with the MSM ladders
+    row-sharded over ``mesh``.
+
+    Returns ``verify(pairs, msg, rng) -> bool`` with the exact semantics
+    of :func:`hbbft_tpu.crypto.batch.batch_verify_sig_shares` (True ⟹
+    every (PublicKeyShare, SignatureShare) pair is valid), routed through
+    the per-mesh ladder cache.  Single-device fallbacks (small batches,
+    CPU backend) keep the verdict bit-identical — the mesh only moves the
+    MSM rows.
+    """
+    from hbbft_tpu.crypto import batch as _cb
+
+    cache = _cb.cache_for(mesh)
+    n_axes = len(tuple(mesh.axis_names)) if mesh is not None else 0
+
+    def verify(pairs, msg, rng):
+        # two ladder folds (G2 sigs, G1 pks); affine point bytes gathered
+        _account("coin", 2 * max(n_axes, 1), len(pairs) * (192 + 96))
+        return _cb.batch_verify_sig_shares(pairs, msg, rng, cache=cache)
+
+    return verify
+
+
+def make_sharded_decrypt(mesh):
+    """Master-scalar-folded threshold decryption with the mask ladder
+    row-sharded over ``mesh``.
+
+    Returns ``decrypt(pks, payloads, secret_shares) -> plaintexts`` with
+    the exact semantics of :func:`hbbft_tpu.crypto.batch.
+    batch_tpke_check_decrypt` (wire-validate + decrypt, ValueError on a
+    malformed payload), routed through the per-mesh ladder cache.  Below
+    the device-decrypt crossover the native/host paths run unchanged —
+    plaintexts are byte-identical either way (tier-1 asserts it).
+    """
+    from hbbft_tpu.crypto import batch as _cb
+
+    cache = _cb.cache_for(mesh)
+    n_axes = len(tuple(mesh.axis_names)) if mesh is not None else 0
+
+    def decrypt(pks, payloads, secret_shares):
+        # one G1 mask ladder fold; affine G1 bytes per ciphertext
+        _account("decrypt", max(n_axes, 1), len(payloads) * 96)
+        return _cb.batch_tpke_check_decrypt(
+            pks, payloads, secret_shares, cache=cache
+        )
+
+    return decrypt

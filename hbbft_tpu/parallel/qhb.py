@@ -54,9 +54,12 @@ class BatchedQueueingHoneyBadger:
 
     def __init__(self, netinfo_map: Dict, batch_size: int = 100,
                  session_id: bytes = b"batched-qhb", encrypt: bool = True,
-                 cost_model=None):
+                 cost_model=None, mesh=None):
+        # mesh= threads straight through to the epoch driver: every epoch
+        # this queue runs — RBC/ABA collectives and crypto ladders alike —
+        # rides the one device mesh (see BatchedHoneyBadgerEpoch)
         self.hb = BatchedHoneyBadgerEpoch(
-            netinfo_map, session_id=session_id, compact=True
+            netinfo_map, session_id=session_id, compact=True, mesh=mesh
         )
         self.ids = self.hb.ids
         self.batch_size = batch_size

@@ -51,6 +51,14 @@ class BatchedRbc:
 
     All methods are pure array functions, safe under ``jax.jit`` /
     ``shard_map`` (static shapes, no Python branching on data).
+
+    Multi-chip: the sharded counterparts live in
+    :mod:`hbbft_tpu.parallel.mesh` — ``make_sharded_rbc_run`` (N ≤ 256:
+    node-axis sharding, proposal fan-out as hierarchical all_gathers)
+    and ``make_sharded_rbc_large_run`` (N > 256: the proposer axis of
+    :meth:`large_stage_a`/``b`` sharded; the straggler decode between
+    the stages stays on the host).  Both are bit-equal to the
+    single-device paths here (tier-1 asserts it).
     """
 
     def __init__(self, n: int, f: int):

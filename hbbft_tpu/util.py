@@ -49,3 +49,31 @@ def enable_compilation_cache(path: Optional[str] = None) -> None:
         # ladder executables that dominate the directory
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def shard_map_compat():
+    """``jax.shard_map`` across jax versions.
+
+    The top-level ``jax.shard_map`` API (with its ``check_vma`` kwarg)
+    graduated out of ``jax.experimental.shard_map`` (where the same knob
+    is spelled ``check_rep``) after the 0.4.x line; this repo's sharded
+    phases are written against the top-level spelling.  Returns the real
+    function when it exists, else a wrapper over the experimental one
+    that translates the kwarg — call sites import this instead of
+    ``from jax import shard_map`` so both jax generations work."""
+    try:
+        from jax import shard_map
+
+        return shard_map
+    except ImportError:
+        import functools
+
+        from jax.experimental.shard_map import shard_map as _sm
+
+        @functools.wraps(_sm)
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                      **kw):
+            return _sm(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=check_vma, **kw)
+
+        return shard_map

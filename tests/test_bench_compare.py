@@ -167,3 +167,51 @@ def test_ingest_sweep_absent_or_empty_is_trivially_ok():
     report = bench.compare_bench(old, new, threshold=0.15)
     assert report["ok"]
     assert not any(c["name"].startswith("ingest[") for c in report["checks"])
+
+
+def test_mesh_mismatch_skips_value_gate():
+    """hb-epoch* records carry mesh_devices; a 1-device vs 8-device
+    recording measures different hardware, so the throughput gate only
+    applies when both sides ran the same mesh."""
+    old = _line()
+    old["mesh_devices"] = 1
+    new = _line(value=2.0)              # 10x slower — but on 8 devices
+    new["mesh_devices"] = 8
+    new["mesh_axes"] = "nodes=8"
+    report = bench.compare_bench(old, new, threshold=0.15)
+    assert report["ok"] and not report["mesh_metrics_compared"]
+    names = {c["name"] for c in report["checks"]}
+    assert "value" not in names
+
+    equal = _line(value=2.0)            # same (absent → 1-device) mesh
+    report = bench.compare_bench(old, equal, threshold=0.15)
+    assert report["mesh_metrics_compared"]
+    assert "value" in report["regressions"]
+
+
+def test_multichip_trajectory_gates_per_device_count():
+    """MULTICHIP recordings gate epochs/s per n_devices point,
+    higher-better; points present on only one side are ignored."""
+    def _traj(points):
+        return {
+            "metric": "multichip_epoch_trajectory",
+            "value": points[-1][1],
+            "unit": "epochs/s",
+            "n_devices": points[-1][0],
+            "trajectory": [
+                {"n_devices": nd, "epochs_per_s": eps} for nd, eps in points
+            ],
+        }
+
+    old = _traj([(1, 30.0), (4, 12.0), (8, 11.0)])
+    good = _traj([(1, 31.0), (4, 13.0), (8, 12.0), (16, 10.0)])  # 16: new
+    report = bench.compare_bench(old, good, threshold=0.15)
+    assert report["ok"]
+    names = {c["name"] for c in report["checks"]}
+    assert "trajectory[4dev].epochs_per_s" in names
+    assert not any("16dev" in n for n in names)
+
+    bad = _traj([(1, 30.0), (4, 6.0), (8, 11.0)])  # 4-dev point halved
+    report = bench.compare_bench(old, bad, threshold=0.15)
+    assert not report["ok"]
+    assert "trajectory[4dev].epochs_per_s" in report["regressions"]

@@ -215,3 +215,94 @@ def test_rs16_encode_into_matches_encode_np():
     shards[:3] = data
     coder.encode_into(shards)
     assert np.array_equal(shards, ref)
+
+
+# ---------------------------------------------------------------------------
+# Decode-side pattern caches (receiver reconstruct hot path)
+# ---------------------------------------------------------------------------
+
+
+def test_lru_bound_and_recency():
+    """The _Lru backing every per-coder compiled-artifact cache: bounded,
+    and ``get`` refreshes recency so hot erasure patterns survive."""
+    lru = rs._Lru(maxsize=3)
+    for i in range(5):
+        lru.put(i, i * 10)
+    assert len(lru) == 3
+    assert 0 not in lru and 1 not in lru and 4 in lru
+    assert lru.get(2) == 20  # refresh 2 → 3 becomes the eviction victim
+    lru.put(5, 50)
+    assert 2 in lru and 3 not in lru
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_rs16_reconstruct_backend_equality(monkeypatch, backend):
+    """GF(2^16) reconstruct_data_np byte-identical across backends (the
+    native SIMD kernel is GF(2^8)-only, so ``native`` must route to the
+    numpy schedule path without diverging)."""
+    monkeypatch.setenv("HBBFT_ERASURE_BACKEND", "numpy")
+    coder = rs.ReedSolomon16(5, 4)  # the n=9-style shape of the GF(2^8) test
+    B = 1026
+    data = _rng(46).integers(0, 256, size=(5, B), dtype=np.uint8)
+    full = coder.encode_np(data)
+
+    monkeypatch.setenv("HBBFT_ERASURE_BACKEND", backend)
+    use = (1, 3, 4, 6, 8)  # mixed data + parity survivors
+    per_backend = rs.ReedSolomon16(5, 4)
+    got = per_backend.reconstruct_data_np(full[list(use)], use)
+    np.testing.assert_array_equal(got, data)
+    # second call exercises the cache-hit path — still identical
+    np.testing.assert_array_equal(
+        per_backend.reconstruct_data_np(full[list(use)], use), data
+    )
+
+
+def test_rs16_reconstruct_above_schedule_col_bound(monkeypatch):
+    """Decode matrices wider than _SCHED_MAX_COLS skip the XOR-schedule
+    compile (quadratic CSE) and use the cached table matmul — results
+    must be identical either way."""
+    monkeypatch.setenv("HBBFT_ERASURE_BACKEND", "numpy")
+    k = rs._SCHED_MAX_COLS + 16
+    coder = rs.ReedSolomon16(k, 20)
+    data = _rng(58).integers(0, 256, size=(k, 64), dtype=np.uint8)
+    full = coder.encode_np(data)
+    # drop the first 20 data rows → survivors = rest of data + all parity
+    use = tuple(range(20, k + 20))
+    got = coder.reconstruct_data_np(full[list(use)], use)
+    np.testing.assert_array_equal(got, data)
+    assert len(coder._sched_cache) == 0  # the wide matrix never compiled
+
+
+def test_rs16_decode_caches_populate_hit_and_count(monkeypatch):
+    monkeypatch.setenv("HBBFT_ERASURE_BACKEND", "numpy")
+    coder = rs.ReedSolomon16(4, 3)
+    data = _rng(9).integers(0, 256, size=(4, 64), dtype=np.uint8)
+    full = coder.encode_np(data)
+    use = (0, 2, 4, 6)
+    before = rs.stats_snapshot()["numpy"]
+    out1 = coder.reconstruct_data_np(full[list(use)], use)
+    assert len(coder._decode_cache) == 1
+    assert len(coder._sched_cache) == 1
+    out2 = coder.reconstruct_data_np(full[list(use)], use)
+    assert len(coder._decode_cache) == 1  # hit — no second inversion entry
+    after = rs.stats_snapshot()["numpy"]
+    assert after["calls"] == before["calls"] + 2  # decode stats still advance
+    assert after["bytes"] == before["bytes"] + 2 * data.size
+    np.testing.assert_array_equal(out1, out2)
+    np.testing.assert_array_equal(out1, data)
+
+
+def test_gf256_reconstruct_data_np_matches_full_reconstruct(monkeypatch):
+    """The new GF(2^8) reconstruct_data_np (pattern-cached inversion +
+    apply) agrees with the long-standing reconstruct_np on the same
+    survivor set."""
+    monkeypatch.setenv("HBBFT_ERASURE_BACKEND", "numpy")
+    coder = rs.ReedSolomon(2, 2)  # N=4 f=1 — the rbc-mb1 bench shape
+    data = _rng(12).integers(0, 256, size=(2, 256), dtype=np.uint8)
+    full = coder.encode_np(data)
+    use = (2, 3)  # worst case: all-parity survivors
+    got = coder.reconstruct_data_np(full[list(use)], use)
+    np.testing.assert_array_equal(got, data)
+    shards = [None, None, bytes(full[2]), bytes(full[3])]
+    assert coder.reconstruct_np(shards) == [bytes(r) for r in full]
+    assert len(coder._decode_cache) == 1  # both calls share one pattern

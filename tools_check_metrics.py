@@ -11,7 +11,7 @@ silently:
 
 1. every metric name registered anywhere in the package (and bench.py)
    follows the naming convention ``hbbft_<layer>_<name>`` with a known
-   layer (``net`` | ``node`` | ``phase`` | ``sim`` | ``obs`` | ``chaos`` | ``sync`` | ``guard``);
+   layer (``net`` | ``node`` | ``phase`` | ``sim`` | ``obs`` | ``chaos`` | ``sync`` | ``guard`` | ``rbc`` | ``load`` | ``mesh``);
 2. every registered metric name is documented in README.md's
    Observability section;
 3. every :class:`hbbft_tpu.fault_log.FaultKind` variant has a
