@@ -1402,7 +1402,9 @@ def _net_run_once(epochs_target: int, n: int, batch_size: int,
     import asyncio
     import gc
     import random
+    import shutil
     import subprocess
+    import tempfile
     from collections import deque
 
     from hbbft_tpu.net.client import latency_percentiles
@@ -1416,13 +1418,17 @@ def _net_run_once(epochs_target: int, n: int, batch_size: int,
     # stop the driver's gen-0 collector from stealing the shared core
     gc.set_threshold(50_000, 25, 25)
     base = find_free_base_port(2 * n)
+    # flight journals (nodes) + client trace journals feed the per-tx
+    # critical-path decomposition (obs.critpath) attached to each run
+    flight_root = tempfile.mkdtemp(prefix=f"bench-critpath-{tag}-")
     cfg = ClusterConfig(n=n, seed=9, batch_size=batch_size,
                         base_port=base, metrics_base_port=base + n,
                         encrypt=encrypt, pipeline_depth=pipeline_depth,
                         link_delays=link_delays, slow_node=slow_node,
                         slow_delay_s=slow_delay_s,
                         aba_delay_nodes=aba_delay_nodes,
-                        aba_out_delay_s=aba_out_delay_s)
+                        aba_out_delay_s=aba_out_delay_s,
+                        flight_dir=flight_root)
     procs = {nid: spawn_node(cfg, nid, stdout=subprocess.DEVNULL,
                              stderr=subprocess.STDOUT)
              for nid in range(n)}
@@ -1437,7 +1443,9 @@ def _net_run_once(epochs_target: int, n: int, batch_size: int,
 
     async def session():
         clients = [
-            await connect_when_up(cfg, nid, client_id=f"bench-{nid}")
+            await connect_when_up(
+                cfg, nid, client_id=f"bench-{nid}",
+                trace_dir=os.path.join(flight_root, f"client-{nid}"))
             for nid in range(n)
         ]
         rng = random.Random(17)
@@ -1541,12 +1549,38 @@ def _net_run_once(epochs_target: int, n: int, batch_size: int,
         net["phases"] = _net_phase_summary(span_dicts)
     finally:
         shutdown_procs(procs.values())
+    # journals are fully flushed once the node processes exited: merge
+    # them with the client trace journals into the per-tx critical path
+    # (components sum exactly to each tx's measured submit→commit wall)
+    try:
+        from hbbft_tpu.obs import critpath as _critpath
+
+        dirs = _critpath.find_journal_dirs(flight_root)
+        if dirs:
+            net["critical_path"] = _critpath.build_report(
+                sorted(dirs), waterfalls=3)
+    except Exception as exc:
+        # attribution is best-effort decoration on the measurement:
+        # the run's numbers stand even when the journals don't parse
+        print(f"# critpath over {flight_root} failed: {exc!r}",
+              file=sys.stderr)
+        net["critical_path"] = {"error": repr(exc)}
+    finally:
+        shutil.rmtree(flight_root, ignore_errors=True)
     net["pipeline_depth"] = pipeline_depth
     net["epochs_per_s"] = round(net["epochs"] / net["wall_s"], 3)
     print(f"# net[{tag}] depth={pipeline_depth} encrypt={encrypt} "
           f"link_delays={link_delays!r}: {net['epochs_per_s']} epochs/s, "
           f"p50={net['p50_ms']}ms p99={net['p99_ms']}ms",
           file=sys.stderr, flush=True)
+    cp50 = (net.get("critical_path") or {}).get("p50")
+    if cp50:
+        comps = " ".join(
+            f"{k}={v * 1e3:.2f}ms" for k, v in cp50["components"].items()
+            if v > 0)
+        print(f"# net[{tag}] critpath p50={cp50['total_s'] * 1e3:.2f}ms "
+              f"dominant={cp50['dominant']} {comps}",
+              file=sys.stderr, flush=True)
     return net
 
 

@@ -43,6 +43,7 @@ from dataclasses import asdict, dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from hbbft_tpu.chaos.link import PRESETS, preset_shape
+from hbbft_tpu.obs import critpath as _critpath
 from hbbft_tpu.obs.audit import AuditResult, run_audit
 from hbbft_tpu.protocols.dynamic_honey_badger import DynamicHoneyBadger
 from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
@@ -268,6 +269,24 @@ def _sim_guard_doc(net, correct) -> Dict[str, Any]:
     }
 
 
+def _cell_critpath(cell_dir: str) -> Optional[Dict[str, Any]]:
+    """Per-cell latency attribution: the critical-path summary over the
+    cell's journals (obs.critpath) — a shaped link (e.g. ``wan-100ms``)
+    must surface as ``wire`` time in the decomposition, not as a
+    mysteriously slow protocol phase."""
+    dirs = _critpath.find_journal_dirs(cell_dir)
+    if not dirs:
+        return None
+    rep = _critpath.build_report(sorted(dirs), waterfalls=0)
+    return {
+        "reconstructed_fraction": rep["reconstructed_fraction"],
+        "mean_components": rep["mean_components"],
+        "p50": rep.get("p50"),
+        "dominant": (rep.get("p50") or {}).get("dominant"),
+        "unmatched": rep["unmatched"],
+    }
+
+
 def run_cell(spec: CellSpec, cell_dir: str
              ) -> Tuple[Dict[str, Any], AuditResult]:
     """One simulator cell: run, record, audit.  Returns the per-cell
@@ -320,6 +339,7 @@ def run_cell(spec: CellSpec, cell_dir: str
         "overload_attributed_to": [
             o["peer"] for o in res.overload_incidents
         ],
+        "critical_path": _cell_critpath(cell_dir),
         "journal": cell_dir,
     }
     return detail, res
@@ -509,7 +529,8 @@ async def _socket_scenario(spec: CellSpec, cell_dir: str
                 cluster.addrs[0], cfg.cluster_id, identity=spec.n - 1,
                 duration_s=20.0))
         sampler = asyncio.ensure_future(sample_gauges())
-        client = await cluster.client(0)
+        client = await cluster.client(
+            0, trace_dir=os.path.join(cell_dir, "client-0"))
         txs = [b"sock-%04d" % i for i in range(spec.txs)]
         # hblint: disable=det-wall-clock (socket cells run a REAL-time
         # cluster under real-second chaos presets: wall time here is the
@@ -589,6 +610,7 @@ def run_socket_cell(spec: CellSpec, cell_dir: str
         "commit_wall_s": live["commit_wall_s"],
         "common_prefix_len": live["common_prefix_len"],
         "pipeline_depth": spec.pipeline_depth,
+        "critical_path": _cell_critpath(cell_dir),
         "journal": cell_dir,
     }
     if "guard" in live:

@@ -126,9 +126,13 @@ class DeterminismChecker(Checker):
     # mesh run and a single-device run must stay bit-identical, so the
     # collective accounting is computed statically from shapes, never
     # from clocks or traced values
+    # obs/trace.py joined the scope with causal tracing: trace ids and
+    # stage records ride the wire (tag 0x95) and must be derivable from
+    # the tx bytes alone — a clock or RNG read here would fork the
+    # byte-identical critpath reports of identical-seed sim runs
     scope = ("hbbft_tpu/protocols/", "hbbft_tpu/parallel/",
              "hbbft_tpu/crypto/", "hbbft_tpu/chaos/",
-             "hbbft_tpu/ops/rs.py")
+             "hbbft_tpu/ops/rs.py", "hbbft_tpu/obs/trace.py")
     rules = {
         "det-wall-clock":
             "wall-clock read in consensus-core code (time.time, "

@@ -25,7 +25,12 @@ a swallowed exception is an invisible Byzantine symptom.
   counted retry (``hbbft_sync_chunk_retries_total``), every donor
   switch a counted failover, and an abandoned transfer must count
   ``hbbft_sync_transfers_abandoned_total`` — a joiner that silently
-  gives up is a wedged validator.
+  gives up is a wedged validator.  ``obs/critpath.py`` rides the
+  ``obs/`` scope with the same contract at the analysis layer:
+  send/receive pairs that never match, trace stages that never pair
+  up, and unalignable processes are *counted* in the report's
+  ``unmatched`` section — an attribution tool that silently drops the
+  evidence it couldn't attribute would be worse than none.
 """
 
 from __future__ import annotations

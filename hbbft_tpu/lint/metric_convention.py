@@ -24,7 +24,8 @@ from typing import Iterable, List, Optional, Tuple
 from hbbft_tpu.lint.core import Checker, Finding, Project, register
 
 NAME_CONVENTION = re.compile(
-    r"^hbbft_(net|node|phase|sim|obs|chaos|sync|guard|rbc|load|mesh)"
+    r"^hbbft_(net|node|phase|sim|obs|chaos|sync|guard|rbc|load|mesh"
+    r"|pump|trace)"
     r"_[a-z][a-z0-9_]*$"
 )
 

@@ -339,7 +339,8 @@ class ClusterClient:
                  client_id: str = "client",
                  max_frame: int = DEFAULT_MAX_FRAME,
                  connect_timeout_s: float = 5.0,
-                 keepalive_s: float = 10.0):
+                 keepalive_s: float = 10.0,
+                 trace_dir: Optional[str] = None):
         self.addr = addr
         self.cluster_id = bytes(cluster_id)
         self.client_id = client_id
@@ -376,6 +377,18 @@ class ClusterClient:
         self._dead: Optional[Exception] = None
         # (digest_hex, submit→commit seconds), in commit order
         self.latencies: List[Tuple[str, float]] = []
+        # per-tx causal tracing (obs.trace / obs.critpath): journal the
+        # client-side stages — submit (TX frame written), ack (the
+        # node's admission reply) and commit_seen (TX_COMMIT arrived) —
+        # with wall-clock timestamps; obs.critpath pairs them with the
+        # node journals to bound the client↔node clock offset
+        self._trace_rec = None
+        if trace_dir:
+            from hbbft_tpu.obs.flight import FlightRecorder
+
+            self._trace_rec = FlightRecorder(
+                trace_dir, node=client_id, flavor="client",
+                clock=time.time)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -406,6 +419,18 @@ class ClusterClient:
                     await task
         if self._writer is not None:
             self._writer.close()
+        if self._trace_rec is not None:
+            self._trace_rec.close()
+
+    def _trace(self, stage: str, tids: bytes, era: int = 0,
+               epoch: int = (1 << 64) - 1) -> None:
+        """Journal one client-side trace stage (no-op without
+        ``trace_dir``); default (era, epoch) is the unknown-epoch
+        sentinel — the client learns the committing epoch only from
+        the TX_COMMIT frame."""
+        if self._trace_rec is not None and tids:
+            self._trace_rec.record_trace(stage, era, epoch, tids,
+                                         detail=self.client_id)
 
     # -- submitting ----------------------------------------------------------
 
@@ -424,6 +449,7 @@ class ClusterClient:
                 fut = asyncio.get_running_loop().create_future()
                 self._acks.setdefault(digest, []).append(fut)
                 self._submit_times.setdefault(digest, time.monotonic())
+                self._trace("submit", digest[:16])
                 async with self._wlock:
                     self._writer.write(framing.encode_frame(
                         framing.TX, tx, self.max_frame
@@ -466,6 +492,9 @@ class ClusterClient:
             futs.append((digest, fut))
             self._submit_times.setdefault(digest, time.monotonic())
             buf += framing.encode_frame(framing.TX, tx, self.max_frame)
+        # one packed trace record for the whole wave (one record per
+        # batch, not per tx — same shape as the node's commit records)
+        self._trace("submit", b"".join(d[:16] for d, _f in futs))
         async with self._wlock:
             self._writer.write(bytes(buf))
             await self._writer.drain()
@@ -617,18 +646,22 @@ class ClusterClient:
                     del self._acks[digest]
                 if not fut.done():
                     fut.set_result(status)
+                if status == framing.ACK_ACCEPTED:
+                    self._trace("ack", digest[:16])
         elif kind == framing.TX_COMMIT:
             # u64 era + u64 epoch + u32 count + count × 32-byte digests;
             # nodes broadcast every committed digest to every client, so
             # only digests we submitted or are awaiting are retained
             era, epoch, count = struct.unpack_from(">QQI", payload, 0)
             now = time.monotonic()
+            seen_tids = []
             for i in range(count):
                 digest = payload[20 + 32 * i : 52 + 32 * i]
                 t0 = self._submit_times.pop(digest, None)
                 waiters = self._commits.pop(digest, None)
                 if t0 is None and waiters is None:
                     continue  # someone else's transaction
+                seen_tids.append(digest[:16])
                 lat = now - t0 if t0 is not None else 0.0
                 if t0 is not None:
                     # hblint: disable=bounded-ingress (one entry per tx
@@ -641,6 +674,7 @@ class ClusterClient:
                 for fut in waiters or ():
                     if not fut.done():
                         fut.set_result(lat)
+            self._trace("commit_seen", b"".join(seen_tids), era, epoch)
         elif kind == framing.STATUS:
             doc = json.loads(payload.decode())
             waiters, self._status_waiters = self._status_waiters, []

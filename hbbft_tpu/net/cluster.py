@@ -510,9 +510,11 @@ class LocalCluster:
         return rt
 
     async def client(self, nid: int,
-                     client_id: str = "client") -> ClusterClient:
+                     client_id: str = "client",
+                     trace_dir: Optional[str] = None) -> ClusterClient:
         client = ClusterClient(
-            self.addrs[nid], self.cfg.cluster_id, client_id=client_id
+            self.addrs[nid], self.cfg.cluster_id, client_id=client_id,
+            trace_dir=trace_dir,
         )
         await client.connect()
         self._clients.append(client)
@@ -642,13 +644,16 @@ def spawn_node(cfg: ClusterConfig, nid: int, *, join: bool = False,
 
 async def connect_when_up(cfg: ClusterConfig, nid: int, *,
                           client_id: Optional[str] = None,
-                          timeout_s: float = 120.0) -> ClusterClient:
+                          timeout_s: float = 120.0,
+                          trace_dir: Optional[str] = None) -> ClusterClient:
     """A connected :class:`ClusterClient` for node ``nid``, retrying while
-    the node process boots."""
+    the node process boots.  ``trace_dir`` journals the client's side of
+    the per-tx causal trace (obs.trace) for ``obs.critpath``."""
     deadline = time.monotonic() + timeout_s
     while True:
         client = ClusterClient(cfg.addr(nid), cfg.cluster_id,
-                               client_id=client_id or f"client-{nid}")
+                               client_id=client_id or f"client-{nid}",
+                               trace_dir=trace_dir)
         try:
             await client.connect()
             return client

@@ -57,6 +57,7 @@ from hbbft_tpu.obs.flight import FlightObserver, FlightRecorder
 from hbbft_tpu.obs.http import ObsServer
 from hbbft_tpu.obs.metrics import MetricAttr, Registry, fault_counter
 from hbbft_tpu.obs.spans import SpanTracer
+from hbbft_tpu.obs.trace import trace_id
 from hbbft_tpu.ops import rs as _rs
 from hbbft_tpu.parallel import mesh as _mesh
 from hbbft_tpu.protocols import wire
@@ -365,12 +366,35 @@ class NodeRuntime:
         self.mempool.on_shed = self._on_mempool_shed
         self._obs_server: Optional[ObsServer] = None
         self.obs_addr: Optional[Addr] = None
+        # Always-on pump segment accounting: the env-gated
+        # HBBFT_PUMP_TIMING accumulators' low-overhead production
+        # sibling.  Observed once per pump iteration per segment
+        # (aggregated within the iteration), so the cost is a handful of
+        # perf_counter reads per batch, not per event — and the per-tx
+        # critical path's pump-queue component (obs.critpath)
+        # cross-checks against a live metric.
+        self._h_pump_seg = self.registry.histogram(
+            "hbbft_pump_segment_seconds",
+            "seconds per pump segment per iteration (msg/input/hello/"
+            "startup/guard/shed = event dispatch by kind; deferred = "
+            "merged threshold-crypto drain; flush = coalesced egress "
+            "writes; queue_wait = the iteration's max inbox wait; "
+            "recv = transport frame receive)",
+            labelnames=("segment",),
+            buckets=(1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0),
+            max_label_sets=12)
+        self._pump_seg = {
+            k: self._h_pump_seg.labels(segment=k)
+            for k in ("msg", "input", "hello", "startup", "guard",
+                      "shed", "deferred", "flush", "queue_wait", "recv")
+        }
         # HBBFT_PUMP_TIMING=1: accumulate per-segment thread time in the
         # pump (perf diagnosis; dumped by run_node on shutdown)
         self._pump_timing: Optional[Dict[str, float]] = (
             {} if os.environ.get("HBBFT_PUMP_TIMING") else None
         )
         self.transport.timing = self._pump_timing
+        self.transport.seg_recv = self._pump_seg["recv"].observe
         self._decode_cache: Dict[bytes, Any] = {}
         # HBBFT_PUMP_RECORD=<dir>: journal pump events as JSONL for
         # offline replay profiling (only with timing enabled)
@@ -544,13 +568,16 @@ class NodeRuntime:
 
     async def start_obs(self, host: str = "127.0.0.1",
                         port: int = 0) -> Addr:
-        """Serve ``/metrics``, ``/status``, ``/spans`` (see obs.http)."""
+        """Serve ``/metrics``, ``/status``, ``/spans``, ``/flight``,
+        ``/trace`` (see obs.http)."""
         self._obs_server = ObsServer(
             self.registry,
             status_fn=self.status_doc,
             spans_fn=self.spans.export_jsonl,
             flight_fn=(self.flight.recorder.tail_jsonl
                        if self.flight is not None else None),
+            trace_fn=(self.flight.recorder.trace_jsonl
+                      if self.flight is not None else None),
         )
         self.obs_addr = await self._obs_server.start(host, port)
         return self.obs_addr
@@ -611,9 +638,11 @@ class NodeRuntime:
 
     def submit_tx(self, tx: bytes) -> int:
         """Local admission (same path as a client TX frame)."""
+        t_ingress = time.time()
         status = self.mempool.add(tx, client_id="_local")
         if status == Mempool.ACCEPTED:
-            self.pump.enqueue("input", self.make_tx_input(tx))
+            self.pump.enqueue("input", self.make_tx_input(tx),
+                              t_ingress, "_local")
         return status
 
     def _on_peer_message(self, peer_id: NodeId, payload: bytes) -> None:
@@ -655,15 +684,29 @@ class NodeRuntime:
         self._out = out
         t_cpu = time.thread_time()
         timing = self._pump_timing
+        pc = time.perf_counter
+        segs: Dict[str, float] = {}
+        t_iter = pc()
+        # queue_wait: the iteration's max inbox wait — how long the
+        # oldest event of this batch sat parked before the pump got to
+        # it (events are (kind, args, t_enq) 3-tuples since the
+        # scheduler started stamping them; bare 2-tuples from direct
+        # pump_process callers still work)
+        max_wait = 0.0
+        for ev in events:
+            if len(ev) > 2 and t_iter - ev[2] > max_wait:
+                max_wait = t_iter - ev[2]
         try:
             if timing is not None:
-                self._pump_process_timed(events, depth, timing)
+                self._pump_process_timed(events, depth, timing, segs)
             else:
-                for kind, args in events:
+                for ev in events:
+                    kind, args = ev[0], ev[1]
+                    t0 = pc()
                     if kind == "msg":
                         self._process_peer_message(*args)
                     elif kind == "input":
-                        self._absorb(self.sq.handle_input(args[0]))
+                        self._process_input(*args)
                     elif kind == "hello":
                         self._process_peer_hello(*args)
                     elif kind == "startup":
@@ -674,24 +717,39 @@ class NodeRuntime:
                         self._process_shed(args[0])
                     else:  # pragma: no cover - enqueue() callers are local
                         raise ValueError(f"unknown pump event {kind!r}")
+                    segs[kind] = segs.get(kind, 0.0) + (pc() - t0)
+                t0 = pc()
                 self._drain_deferred()
                 if depth > 1:
                     self._absorb(self.sq.handle_input(PipelineInput(depth)))
                     self._drain_deferred()
+                segs["deferred"] = segs.get("deferred", 0.0) + (pc() - t0)
             self._prune_replay()
         finally:
             out.cpu_s = time.thread_time() - t_cpu
             self._out = None
+        children = self._pump_seg
+        for k, v in segs.items():
+            child = children.get(k)
+            if child is not None:
+                child.observe(v)
+        if events:
+            children["queue_wait"].observe(max_wait)
         return out
 
-    def _pump_process_timed(self, events, depth: int, timing) -> None:
+    def _pump_process_timed(self, events, depth: int, timing,
+                            segs: Dict[str, float]) -> None:
         """``HBBFT_PUMP_TIMING`` variant of the iteration body: same
         semantics, with per-segment thread-time accumulators (decode /
         protocol / spans / dispatch split inside _process_peer_message is
-        approximated by timing that call whole)."""
+        approximated by timing that call whole).  ``segs`` receives the
+        wall-clock per-kind split so the always-on
+        ``hbbft_pump_segment_seconds`` histogram stays populated in this
+        mode too."""
         rec = self._pump_record
         if rec is not None:
-            for kind, args in events:
+            for ev in events:
+                kind, args = ev[0], ev[1]
                 if kind == "msg":
                     rec.write('["msg",%d,"%s"]\n'
                               % (args[0], args[1].hex()))
@@ -700,12 +758,15 @@ class NodeRuntime:
                     if tx is not None:
                         rec.write('["input","%s"]\n' % tx.hex())
         tt = time.thread_time
-        for kind, args in events:
+        pc = time.perf_counter
+        for ev in events:
+            kind, args = ev[0], ev[1]
             t0 = tt()
+            w0 = pc()
             if kind == "msg":
                 self._process_peer_message(*args)
             elif kind == "input":
-                self._absorb(self.sq.handle_input(args[0]))
+                self._process_input(*args)
             elif kind == "hello":
                 self._process_peer_hello(*args)
             elif kind == "startup":
@@ -718,12 +779,15 @@ class NodeRuntime:
                 raise ValueError(f"unknown pump event {kind!r}")
             timing[kind] = timing.get(kind, 0.0) + (tt() - t0)
             timing["n_" + kind] = timing.get("n_" + kind, 0.0) + 1
+            segs[kind] = segs.get(kind, 0.0) + (pc() - w0)
         t0 = tt()
+        w0 = pc()
         self._drain_deferred()
         if depth > 1:
             self._absorb(self.sq.handle_input(PipelineInput(depth)))
             self._drain_deferred()
         timing["deferred"] = timing.get("deferred", 0.0) + (tt() - t0)
+        segs["deferred"] = segs.get("deferred", 0.0) + (pc() - w0)
 
     def _drain_deferred(self) -> None:
         """Resolve every parked threshold-decrypt verification — ONE
@@ -741,13 +805,15 @@ class NodeRuntime:
         """Apply one iteration's side effects on the event loop: coalesced
         MSG/MSG_BATCH frames per peer, then client commit pushes."""
         timing = self._pump_timing
+        w0 = time.perf_counter()
         if timing is not None:
             t0 = time.thread_time()
             self._pump_flush_body(out)
             timing["flush"] = (
                 timing.get("flush", 0.0) + (time.thread_time() - t0))
-            return
-        self._pump_flush_body(out)
+        else:
+            self._pump_flush_body(out)
+        self._pump_seg["flush"].observe(time.perf_counter() - w0)
 
     def _pump_flush_body(self, out: _PumpOutcome) -> None:
         for dest, payloads in out.frames.items():
@@ -782,6 +848,25 @@ class NodeRuntime:
             self.send_failures += 1
             logger.warning("no transport peer for %r: dropped %d shaped "
                            "payloads", dest, len(payloads))
+
+    def _process_input(self, inp: Any, t_ingress: Optional[float] = None,
+                       client: str = "") -> None:
+        """A mempool-admitted input (pump thread): journal its per-tx
+        ``ingress`` (event-loop admission time, captured at the mempool
+        add) and ``queued`` (now: the pump dequeued it) trace stages —
+        the journal append itself stays on the pump thread, the one
+        place appends are allowed — then feed the protocol."""
+        if self.flight is not None:
+            tx = getattr(inp, "tx", None)
+            if isinstance(tx, (bytes, bytearray)):
+                tid = trace_id(bytes(tx))
+                era, epoch = self.current_key()
+                self.flight.recorder.record_trace(
+                    "ingress", era, epoch, tid, detail=client,
+                    t=t_ingress)
+                self.flight.recorder.record_trace(
+                    "queued", era, epoch, tid, t=time.time())
+        self._absorb(self.sq.handle_input(inp))
 
     def _process_guard_event(self, kind: str, peer_id: NodeId,
                              detail: str) -> None:
@@ -1131,11 +1216,13 @@ class NodeRuntime:
             # pressure) and the ack stay on the event loop — backpressure
             # must not wait behind a pump iteration; only the accepted
             # input crosses into the pump
+            t_ingress = time.time()
             status = self.mempool.add(payload,
                                       client_id=str(conn.client_id))
             conn.send(framing.TX_ACK, bytes([status]) + tx_digest(payload))
             if status == Mempool.ACCEPTED:
-                self.pump.enqueue("input", self.make_tx_input(payload))
+                self.pump.enqueue("input", self.make_tx_input(payload),
+                                  t_ingress, str(conn.client_id))
         elif kind == framing.STATUS_REQ:
             # optional u32 payload: digest-chain tail length (0 = just the
             # head/length — the cheap poll loops use this; the full

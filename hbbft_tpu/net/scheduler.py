@@ -51,6 +51,7 @@ import contextlib
 import logging
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
+from time import perf_counter
 from typing import Any, Deque, Optional, Tuple
 
 logger = logging.getLogger("hbbft_tpu.net")
@@ -82,7 +83,7 @@ class StepPump:
         self.runtime = runtime
         self.pipeline_depth = pipeline_depth
         self.max_batch = max_batch
-        self._inbox: Deque[Tuple[str, tuple]] = deque()
+        self._inbox: Deque[Tuple[str, tuple, float]] = deque()
         self._wake: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
         self._executor = ThreadPoolExecutor(
@@ -132,8 +133,14 @@ class StepPump:
     # -- ingress (event-loop side) -------------------------------------------
 
     def enqueue(self, kind: str, *args) -> None:
-        """Queue one event; processing order is strict FIFO."""
-        self._inbox.append((kind, args))
+        """Queue one event; processing order is strict FIFO.
+
+        Each event carries its enqueue time (``perf_counter``) so the
+        pump can account queue-wait — the latency the event spent parked
+        in the inbox before its iteration started — in the
+        ``hbbft_pump_segment_seconds`` histogram and the per-tx critical
+        path."""
+        self._inbox.append((kind, args, perf_counter()))
         if self._wake is not None:
             self._wake.set()
 

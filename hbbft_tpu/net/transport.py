@@ -1181,6 +1181,10 @@ class Transport:
                                writer: asyncio.StreamWriter,
                                decoder: FrameDecoder, state: list) -> None:
         timing = getattr(self, "timing", None)
+        # always-on recv segment observer (the runtime wires the
+        # hbbft_pump_segment_seconds "recv" child here); one observe per
+        # socket chunk, a perf_counter pair of overhead
+        seg_recv = getattr(self, "seg_recv", None)
         guard = self.ingress
         while not self._stopping:
             data = await reader.read(65536)
@@ -1190,14 +1194,19 @@ class Transport:
                         f"peer {peer_id!r} recv idle timeout")
                 return
             state[0] = time.monotonic()
-            if timing is None:
+            if timing is None and seg_recv is None:
                 self._recv_chunk(peer_id, writer, decoder, data)
             else:
-                t0 = time.thread_time()
+                w0 = time.perf_counter()
+                t0 = time.thread_time() if timing is not None else 0.0
                 self._recv_chunk(peer_id, writer, decoder, data)
-                timing["recv"] = (
-                    timing.get("recv", 0.0) + (time.thread_time() - t0))
-                timing["n_recv"] = timing.get("n_recv", 0) + 1
+                if timing is not None:
+                    timing["recv"] = (
+                        timing.get("recv", 0.0)
+                        + (time.thread_time() - t0))
+                    timing["n_recv"] = timing.get("n_recv", 0) + 1
+                if seg_recv is not None:
+                    seg_recv(time.perf_counter() - w0)
             # ingress budget: over-budget peers pause the read (the TCP
             # window closes → real backpressure); sustained violation or
             # a runtime-reported garbage stream tears the connection

@@ -651,6 +651,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--status", action="append", default=[],
                     metavar="HOST:PORT",
                     help="cross-check a live node's /status chain head")
+    ap.add_argument("--critpath", action="store_true",
+                    help="append the per-tx critical-path report "
+                         "(obs.critpath) over the same journals")
     args = ap.parse_args(argv)
     try:
         res, _journals = run_audit(args.paths)
@@ -673,11 +676,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                                          f"({exc!r})")
             continue
         cross_check_status(res, doc)
+    cp_report = None
+    if args.critpath:
+        from hbbft_tpu.obs import critpath as _critpath
+
+        dirs: List[str] = []
+        for p in args.paths:
+            dirs.extend(find_journal_dirs(p))
+        cp_report = _critpath.build_report(sorted(dirs))
     if args.json:
-        print(json.dumps(res.as_dict(), sort_keys=True))
+        doc = res.as_dict()
+        if cp_report is not None:
+            doc["critical_path"] = cp_report
+        print(json.dumps(doc, sort_keys=True))
     else:
         sys.stdout.write(format_report(res, timeline=args.timeline,
                                        window=args.window))
+        if cp_report is not None:
+            print("-- critical path --")
+            print(_critpath.render(cp_report))
     return 0 if res.verdict == "clean" else 1
 
 

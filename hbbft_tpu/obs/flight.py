@@ -56,6 +56,9 @@ from dataclasses import dataclass, fields
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from hbbft_tpu.obs.metrics import DEFAULT, Registry
+from hbbft_tpu.obs.trace import (
+    STAGE_HOPS, FlightTrace, iter_tids, pack_tids, trace_id,
+)
 from hbbft_tpu.protocols import wire
 from hbbft_tpu.traits import Step, StepObserver
 
@@ -165,7 +168,7 @@ class FlightNote:
 
 
 RECORD_TYPES = (FlightHello, FlightMsg, FlightCommit, FlightFault,
-                FlightSpan, FlightNote)
+                FlightSpan, FlightNote, FlightTrace)
 
 
 def record_as_dict(rec: Any) -> Dict[str, Any]:
@@ -179,6 +182,10 @@ def record_as_dict(rec: Any) -> Dict[str, Any]:
             out[f.name + "_bytes"] = len(v)
         else:
             out[f.name] = v
+    if isinstance(rec, FlightTrace):
+        # trace ids are identifiers, not payloads — show them outright
+        # so ``/trace`` output can be grepped by tid
+        out["tids"] = [t.hex() for t in iter_tids(rec.tids)]
     return out
 
 
@@ -421,9 +428,14 @@ class FlightRecorder:
         self._seq += 1
         return self._seq
 
-    def _now(self) -> float:
-        # logical clock: the NEXT record's seq — deterministic runs get
-        # deterministic timestamps
+    def _now(self, t: Optional[float] = None) -> float:
+        # an explicit t wins (the drivers pass the event's own time —
+        # the virtual clock under the sim, so determinism holds; the
+        # capture-site clock under sockets, so the journal timestamp is
+        # the event, not the append); otherwise the logical clock (the
+        # NEXT record's seq) or the recorder's clock
+        if t is not None:
+            return t
         return float(self._seq + 1) if self.clock is None else self.clock()
 
     def _append(self, rec: Any) -> None:
@@ -449,20 +461,21 @@ class FlightRecorder:
                 self._seg_records > 1:
             self._rotate()
 
-    def record_msg(self, direction: str, peer: str, message: Any) -> None:
+    def record_msg(self, direction: str, peer: str, message: Any,
+                   t: Optional[float] = None) -> None:
         try:
             payload = wire.encode_message(message)
         except TypeError:
             self._c_encode_skip.inc()
             payload = b""
         era, epoch = message_epoch(message)
-        self._append(FlightMsg(self._next_seq(), self._now(), direction,
+        self._append(FlightMsg(self._next_seq(), self._now(t), direction,
                                peer, era, epoch, type(message).__name__,
                                payload))
 
     def record_commit(self, era: int, epoch: int, index: int,
-                      digest: bytes) -> None:
-        self._append(FlightCommit(self._next_seq(), self._now(), era,
+                      digest: bytes, t: Optional[float] = None) -> None:
+        self._append(FlightCommit(self._next_seq(), self._now(t), era,
                                   epoch, index, digest))
         if index > self._cur_commit_high:
             self._cur_commit_high = index
@@ -503,9 +516,19 @@ class FlightRecorder:
         return removed
 
     def record_fault(self, node: str, kind: str, era: int = 0,
-                     epoch: int = UNKNOWN_EPOCH) -> None:
-        self._append(FlightFault(self._next_seq(), self._now(), node,
+                     epoch: int = UNKNOWN_EPOCH,
+                     t: Optional[float] = None) -> None:
+        self._append(FlightFault(self._next_seq(), self._now(t), node,
                                  kind, era, epoch))
+
+    def record_trace(self, stage: str, era: int, epoch: int, tids: bytes,
+                     detail: str = "", t: Optional[float] = None) -> None:
+        """One causal stage crossing (see :mod:`hbbft_tpu.obs.trace`):
+        ``tids`` is the concatenated 16-byte trace-id vector of every tx
+        crossing ``stage`` together — one record per batch, not per tx."""
+        self._append(FlightTrace(self._next_seq(), self._now(t), stage,
+                                 era, epoch, STAGE_HOPS.get(stage, 0),
+                                 detail, tids))
 
     def record_span(self, span: Any) -> None:
         """Sink for :attr:`hbbft_tpu.obs.spans.SpanTracer.sink`."""
@@ -536,6 +559,13 @@ class FlightRecorder:
         """Recent records as JSONL — the ``/flight`` endpoint body."""
         return "\n".join(json.dumps(d) for d in self.tail) + (
             "\n" if self.tail else "")
+
+    def trace_jsonl(self) -> str:
+        """The tail's FlightTrace records only — the ``/trace``
+        endpoint body (per-tx causal stages, tids in hex)."""
+        rows = [d for d in self.tail if d.get("type") == "FlightTrace"]
+        return "\n".join(json.dumps(d) for d in rows) + (
+            "\n" if rows else "")
 
 
 # ===========================================================================
@@ -579,7 +609,19 @@ class FlightObserver(StepObserver):
         if self.spans is not None:
             self.spans.on_message(sender_id, message, t)
         self._last_key = message_epoch(message)
-        self.recorder.record_msg("in", repr(sender_id), message)
+        self.recorder.record_msg("in", repr(sender_id), message, t=t)
+
+    def on_input(self, sender_id: Any, inp: Any,
+                 t: Optional[float] = None) -> None:
+        # locally-admitted contribution: journal the ingress stage of
+        # every tx it carries so the critical path starts on this node
+        # even without a socket client (the VirtualNet composition;
+        # NodeRuntime journals ingress itself at mempool admission)
+        tx = getattr(inp, "tx", None)
+        if isinstance(tx, (bytes, bytearray)):
+            self.recorder.record_trace("ingress", 0, UNKNOWN_EPOCH,
+                                       trace_id(bytes(tx)),
+                                       detail=repr(sender_id), t=t)
 
     def on_step(self, step: Step, t: Optional[float] = None) -> None:
         from hbbft_tpu.obs.spans import _batch_key
@@ -592,20 +634,26 @@ class FlightObserver(StepObserver):
             # timeline (UNKNOWN_EPOCH for input-driven steps)
             self.recorder.record_fault(repr(fault.node_id),
                                        fault.kind.name,
-                                       *self._last_key)
+                                       *self._last_key, t=t)
         for out in step.output:
             key = _batch_key(out)
             if key is None:
                 continue
             era, epoch, _complete = key
+            all_txs = getattr(out, "all_txs", None)
+            if all_txs is not None:
+                tids = pack_tids(trace_id(tx) for tx in all_txs())
+                if tids:
+                    self.recorder.record_trace("commit", era, epoch,
+                                               tids, t=t)
             self._ledger = hashlib.sha3_256(
                 self._ledger + wire.batch_bytes(out)).digest()
             self.recorder.record_commit(era, epoch, self._chain_len,
-                                        self._ledger)
+                                        self._ledger, t=t)
             self._chain_len += 1
         for tm in step.messages:
             self.recorder.record_msg("out", target_str(tm.target),
-                                     tm.message)
+                                     tm.message, t=t)
 
     def on_note(self, kind: str, detail: str,
                 t: Optional[float] = None) -> None:

@@ -7,7 +7,9 @@ One :class:`ObsServer` per node serves:
 - ``GET /spans``   — finished epoch-phase spans as JSONL
   (``application/x-ndjson``), newest-bounded (see ``SpanTracer.max_spans``);
 - ``GET /flight``  — the flight recorder's in-memory record tail as JSONL
-  (payloads summarized as digest+size; the on-disk journal has the bytes).
+  (payloads summarized as digest+size; the on-disk journal has the bytes);
+- ``GET /trace``   — the tail filtered to per-tx causal trace records
+  (``obs.trace``), tids in hex — grep a tid across nodes live.
 
 Deliberately tiny: request line + headers are read with a hard cap and a
 timeout, responses are ``Connection: close``, and anything but a known GET
@@ -37,11 +39,13 @@ class ObsServer:
 
     def __init__(self, registry, status_fn: Optional[Callable[[], dict]] = None,
                  spans_fn: Optional[Callable[[], str]] = None,
-                 flight_fn: Optional[Callable[[], str]] = None):
+                 flight_fn: Optional[Callable[[], str]] = None,
+                 trace_fn: Optional[Callable[[], str]] = None):
         self.registry = registry
         self.status_fn = status_fn
         self.spans_fn = spans_fn
         self.flight_fn = flight_fn
+        self.trace_fn = trace_fn
         self._c_dropped = registry.counter(
             "hbbft_obs_http_dropped_requests_total",
             "obs-endpoint requests dropped (malformed, timed out, or "
@@ -78,8 +82,11 @@ class ObsServer:
         if path == "/flight":
             body = self.flight_fn() if self.flight_fn is not None else ""
             return (200, "application/x-ndjson", body)
+        if path == "/trace":
+            body = self.trace_fn() if self.trace_fn is not None else ""
+            return (200, "application/x-ndjson", body)
         return (404, "text/plain; charset=utf-8",
-                "not found; try /metrics /status /spans /flight\n")
+                "not found; try /metrics /status /spans /flight /trace\n")
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:

@@ -11,7 +11,10 @@ across nodes), which is the "where does the epoch latency go" line.
     python -m hbbft_tpu.obs.top --base-port 26000 --nodes 4
 
 ``--iterations N`` renders N frames then exits (``1`` = one plain snapshot,
-used by scripts/tests); the default runs until interrupted.
+used by scripts/tests); the default runs until interrupted.  ``--json``
+polls ONCE and emits the whole snapshot — per-node status, mesh-collective
+and loadgen (``hbbft_load_*``) totals, cluster phase quantiles — as one
+JSON document for scripts to consume.
 """
 
 from __future__ import annotations
@@ -57,6 +60,16 @@ def poll_target(host: str, port: int, timeout_s: float = 2.0
         return None
 
 
+def metric_total(snap: dict, name: str) -> Optional[float]:
+    """Sum of one counter family across its label sets, None if the
+    node doesn't export it (e.g. ``hbbft_load_*`` without an embedded
+    load generator)."""
+    series = snap["metrics"].get(name)
+    if not series:
+        return None
+    return sum(v for _labels, v in series)
+
+
 def phase_quantiles(snaps: List[Optional[dict]],
                     qs=(0.5, 0.99)) -> Dict[str, List[float]]:
     """Cluster-wide per-phase quantiles: histogram buckets summed over
@@ -93,7 +106,8 @@ def render(targets: List[Target], prev: List[Optional[dict]],
         f"{'node':<22} {'era':>4} {'epoch':>6} {'batch':>6} "
         f"{'ep/s':>6} {'mempool':>8} {'peers':>5} {'txs':>8} "
         f"{'faults':>6} {'decode!':>7} {'gaps':>5} {'guard!':>6} "
-        f"{'jrnl':>7} {'jseg':>4} {'jwf':>4}"
+        f"{'jrnl':>7} {'jseg':>4} {'jwf':>4} {'mesh':>6} "
+        f"{'load':>8} {'shed':>5}"
     )
     for i, (host, port) in enumerate(targets):
         snap = cur[i]
@@ -122,13 +136,25 @@ def render(targets: List[Target], prev: List[Optional[dict]],
         guard = (gi.get("throttles", 0) + gi.get("disconnects", 0)
                  + gd.get("senderq_evictions", 0)
                  + sum((gd.get("mempool_sheds") or {}).values()))
+        # mesh-sharded epoch collectives (zero on single-device nodes)
+        # and embedded-loadgen counters ("-" when no generator runs in
+        # this process — hbbft_load_* lives in whichever registry hosts
+        # the OpenLoopGenerator)
+        mesh = metric_total(snap, "hbbft_mesh_collectives_total")
+        load = metric_total(snap, "hbbft_load_submitted_txs_total")
+        shed = metric_total(snap, "hbbft_load_shed_txs_total")
+
+        def _i(v: Optional[float]) -> str:
+            return "-" if v is None else str(int(v))
+
         lines.append(
             f"{name:<22} {d['era']:>4} {d['epoch']:>6} "
             f"{d['batches']:>6} {rate:>6} {d['mempool']:>8} "
             f"{d['peers_connected']:>5} {d['committed_txs']:>8} "
             f"{d['faults_observed']:>6} {d['decode_failures']:>7} "
             f"{d['replay_gaps']:>5} {guard:>6} "
-            f"{jrnl:>7} {jseg:>4} {jwf:>4}"
+            f"{jrnl:>7} {jseg:>4} {jwf:>4} {_i(mesh):>6} "
+            f"{_i(load):>8} {_i(shed):>5}"
         )
     pq = phase_quantiles(cur)
     lines.append("")
@@ -141,6 +167,39 @@ def render(targets: List[Target], prev: List[Optional[dict]],
     if not pq:
         lines.append("(no finished epochs yet)")
     return "\n".join(lines)
+
+
+def snapshot_doc(targets: List[Target],
+                 cur: List[Optional[dict]]) -> dict:
+    """One-shot machine-readable snapshot (``--json``)."""
+    nodes = []
+    for i, (host, port) in enumerate(targets):
+        snap = cur[i]
+        if snap is None:
+            nodes.append({"target": f"{host}:{port}", "up": False})
+            continue
+        nodes.append({
+            "target": f"{host}:{port}",
+            "up": True,
+            "status": snap["status"],
+            "mesh_collectives": metric_total(
+                snap, "hbbft_mesh_collectives_total"),
+            "mesh_gather_bytes": metric_total(
+                snap, "hbbft_mesh_gather_bytes_total"),
+            "load": {
+                k: metric_total(snap, f"hbbft_load_{k}_total")
+                for k in ("offered_txs", "submitted_txs", "acks",
+                          "shed_txs", "committed_txs")
+            },
+        })
+    pq = phase_quantiles(cur)
+    return {
+        "nodes": nodes,
+        "phase_quantiles_ms": {
+            ph: {"p50": v[0] * 1e3, "p99": v[1] * 1e3}
+            for ph, v in sorted(pq.items())
+        },
+    }
 
 
 def parse_targets(args) -> List[Target]:
@@ -166,8 +225,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--interval", type=float, default=1.0)
     ap.add_argument("--iterations", type=int, default=0,
                     help="0 = run until interrupted; 1 = one snapshot")
+    ap.add_argument("--json", action="store_true",
+                    help="poll once, print a JSON snapshot, exit")
     args = ap.parse_args(argv)
     targets = parse_targets(args)
+
+    if args.json:
+        import json
+
+        cur = [poll_target(h, p) for h, p in targets]
+        print(json.dumps(snapshot_doc(targets, cur), sort_keys=True))
+        return 0 if any(s is not None for s in cur) else 1
 
     clear = (sys.stdout.isatty() and args.iterations != 1)
     prev: List[Optional[dict]] = [None] * len(targets)

@@ -526,6 +526,15 @@ def _lazy_register():
     _register(0x94, SyncNack,
               lambda m: s(m.reason),
               lambda r: SyncNack(rs(r)))
+    # per-tx causal trace record (obs/trace.py) ------------------------------
+    from hbbft_tpu.obs.trace import FlightTrace
+
+    _register(0x95, FlightTrace,
+              lambda m: (u64(m.seq) + f64(m.t) + s(m.stage) + u64(m.era)
+                         + u64(m.epoch) + u32(m.hop) + s(m.detail)
+                         + blob(m.tids)),
+              lambda r: FlightTrace(r.u64(), r.f64(), rs(r), r.u64(),
+                                    r.u64(), r.u32(), rs(r), r.blob()))
 
 
 def ensure_registered():

@@ -100,10 +100,19 @@ class VirtualNet:
     def send_input(self, node_id: NodeId, input: Any) -> None:
         """Feed an input to a node and fan out its step."""
         node = self.nodes[node_id]
-        step = node.algorithm.handle_input(input)
         obs = self.observers.get(node_id)
+        # with a cost model the virtual clock is meaningful: stamp the
+        # ingress (and the step) with it so per-tx traces and spans
+        # share the journal's timebase; without one, None → each
+        # observer falls back to its own (logical) clock
+        t = self.virtual_time if self.cost_model is not None else None
         if obs is not None:
-            obs.on_step(step)
+            on_input = getattr(obs, "on_input", None)
+            if on_input is not None:
+                on_input(node_id, input, t)
+        step = node.algorithm.handle_input(input)
+        if obs is not None:
+            obs.on_step(step, t)
         self._process_step(node, step)
 
     def crank(self) -> Optional[NetworkMessage]:
@@ -126,36 +135,43 @@ class VirtualNet:
         dest = self.nodes.get(msg.to)
         if dest is None:
             return msg
-        obs = self.observers.get(msg.to)
-        if obs is not None:
-            obs.on_message(msg.sender, msg.payload)
-        step = dest.algorithm.handle_message(msg.sender, msg.payload)
-        if obs is not None:
-            obs.on_step(step)
-        self._process_step(dest, step)
-        self.messages_delivered += 1
+        nbytes = 0
+        t_deliver: Optional[float] = None
         if self.trace is not None or self.cost_model is not None:
-            from hbbft_tpu.sim.trace import (
-                CrankEvent, msg_type_path, wire_size,
-            )
+            from hbbft_tpu.sim.trace import wire_size
 
             nbytes = wire_size(msg.payload)
             if self.cost_model is not None:
-                t = max(self.node_times.get(msg.to, 0.0), msg.at) \
+                # the virtual delivery time is charged BEFORE the handler
+                # runs, so observers (spans, per-tx traces) stamp events
+                # with the time they happened on the virtual clock — the
+                # deterministic-timestamp half of obs.critpath
+                t_deliver = max(self.node_times.get(msg.to, 0.0), msg.at) \
                     + self.cost_model.charge(nbytes)
-                self.node_times[msg.to] = t
-                self.virtual_time = max(self.virtual_time, t)
-            if self.trace is not None:
-                self.trace.record(CrankEvent(
-                    crank=self.cranks,
-                    sender=msg.sender,
-                    dest=msg.to,
-                    msg_type=msg_type_path(msg.payload),
-                    wire_bytes=nbytes,
-                    outputs=len(step.output),
-                    faults=len(step.fault_log),
-                    virtual_time=self.virtual_time,
-                ))
+        obs = self.observers.get(msg.to)
+        if obs is not None:
+            obs.on_message(msg.sender, msg.payload, t_deliver)
+        step = dest.algorithm.handle_message(msg.sender, msg.payload)
+        if obs is not None:
+            obs.on_step(step, t_deliver)
+        self._process_step(dest, step)
+        self.messages_delivered += 1
+        if t_deliver is not None:
+            self.node_times[msg.to] = t_deliver
+            self.virtual_time = max(self.virtual_time, t_deliver)
+        if self.trace is not None:
+            from hbbft_tpu.sim.trace import CrankEvent, msg_type_path
+
+            self.trace.record(CrankEvent(
+                crank=self.cranks,
+                sender=msg.sender,
+                dest=msg.to,
+                msg_type=msg_type_path(msg.payload),
+                wire_bytes=nbytes,
+                outputs=len(step.output),
+                faults=len(step.fault_log),
+                virtual_time=self.virtual_time,
+            ))
         if (
             self.message_limit is not None
             and self.messages_delivered > self.message_limit

@@ -215,6 +215,12 @@ class StepObserver:
                    t: Optional[float] = None) -> None:
         """One inbound protocol message, before it is handled."""
 
+    def on_input(self, sender_id: NodeId, input: Any,
+                 t: Optional[float] = None) -> None:
+        """A locally-admitted input (contribution), before it is handled
+        — the ingress end of the per-tx causal trace
+        (``obs.trace`` / ``obs.critpath``)."""
+
     def on_step(self, step: "Step", t: Optional[float] = None) -> None:
         """The Step the protocol returned (outputs close epochs)."""
 

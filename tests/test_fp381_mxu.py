@@ -141,6 +141,7 @@ def test_g1_lazy_ladder_mxu_ops_matches_host():
             assert H.g1_eq(host_pts[i], expect), i
 
 
+@pytest.mark.slow
 def test_g2_lazy_ladder_mxu_ops_matches_host():
     import random
 
@@ -165,6 +166,7 @@ def test_g2_lazy_ladder_mxu_ops_matches_host():
         assert H.g2_eq(host_pts[i], H.g2_mul(base[i], scalars[i])), i
 
 
+@pytest.mark.slow
 def test_windowed_ladder_matches_bitwise_and_host():
     """scalar_mul_lazy_window == scalar_mul_lazy == host, G1 MXU ops."""
     import random

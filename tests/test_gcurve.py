@@ -66,6 +66,7 @@ def g1_batch():
     return pts_h, scals, pts, bits
 
 
+@pytest.mark.slow
 def test_g1_add_complete_cases(g1_batch):
     pts_h, _, pts, _ = g1_batch
     B = len(pts_h)
@@ -104,9 +105,13 @@ def _g1_msm_case(nbits, scalar_pairs):
         assert H.g1_eq(G.g1_from_device(tuple(np.asarray(c) for c in m)), expect)
 
 
+@pytest.mark.slow
 def test_g1_msm_ladder_and_tree():
-    """64-bit ladder by default (same per-step machinery as full width;
-    compile is minutes shorter).  Full 255-bit width: --slow."""
+    """64-bit ladder (same per-step machinery as full width; compile is
+    minutes shorter).  Tier 1 keeps ``test_lazy_g1_msm_packed_path`` as
+    the G1 MSM representative — the packed path is what production
+    dispatch uses, and this unpacked ladder's 144 s compile is all
+    redundant machinery on top of it."""
     rng = random.Random(13)
     _g1_msm_case(64, [
         (0, rng.randrange(1, 1 << 64)),

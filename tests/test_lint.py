@@ -217,6 +217,36 @@ def test_chaos_is_in_determinism_and_drop_scopes(tmp_path):
     assert [f.rule for f in result.findings] == ["fault-swallowed-drop"]
 
 
+def test_trace_is_in_determinism_scope_and_critpath_in_drop_scope(
+        tmp_path):
+    """obs/trace.py joined the determinism scope with causal tracing
+    (trace ids ride the wire; a clock read there forks identical-seed
+    critpath reports), and obs/critpath.py rides the obs/ drop scope
+    (unmatched pairs must be counted, never silently discarded)."""
+    assert "hbbft_tpu/obs/trace.py" in DeterminismChecker.scope
+    assert any("hbbft_tpu/obs/critpath.py".startswith(p)
+               for p in FaultAccountingChecker.DROP_SCOPE)
+    _write(tmp_path, "hbbft_tpu/obs/trace.py", _VIOLATION)
+    result = _lint_tmp(tmp_path)
+    assert [f.rule for f in result.findings] == ["det-wall-clock"]
+    # the rest of obs/ stays OUT of the determinism scope (runtime
+    # journals legitimately stamp wall-clock time)
+    _write(tmp_path, "hbbft_tpu/obs/other.py", _VIOLATION)
+    result = run_lint(root=str(tmp_path),
+                      paths=["hbbft_tpu/obs/other.py"],
+                      checkers=[DeterminismChecker()],
+                      baseline_path=None)
+    assert result.findings == []
+
+
+def test_pump_and_trace_metric_prefixes_pass_convention():
+    from hbbft_tpu.lint.metric_convention import NAME_CONVENTION
+
+    assert NAME_CONVENTION.match("hbbft_pump_segment_seconds")
+    assert NAME_CONVENTION.match("hbbft_trace_records_total")
+    assert not NAME_CONVENTION.match("hbbft_bogus_prefix_total")
+
+
 def test_suppression_same_line(tmp_path):
     _write(tmp_path, "hbbft_tpu/protocols/x.py",
            "import time\n\ndef f():\n"

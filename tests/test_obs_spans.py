@@ -299,3 +299,72 @@ def test_wire_size_failure_is_counted_and_logged_once(caplog):
     from hbbft_tpu.protocols.broadcast import ReadyMsg
 
     assert wire_size(ReadyMsg(b"\0" * 32)) > 0
+
+
+# ---------------------------------------------------------------------------
+# epoch pipelining (pipeline_depth >= 2): overlapping epochs stay separate
+# ---------------------------------------------------------------------------
+
+
+def test_pipelined_epochs_finalize_one_span_set_each():
+    """The pipeline_depth >= 2 message shape: epoch e+1's RBC/ABA traffic
+    interleaves with epoch e's before EITHER commits.  Each commit must
+    finalize exactly one span set, every phase attributed to the epoch
+    its messages named — and a straggler for a finalized epoch must not
+    re-open it."""
+    from hbbft_tpu.protocols.binary_agreement import AuxMsg, BValMsg
+    from hbbft_tpu.protocols.broadcast import ReadyMsg
+    from hbbft_tpu.protocols.dynamic_honey_badger import HbWrap
+    from hbbft_tpu.protocols.honey_badger import Batch, SubsetWrap
+    from hbbft_tpu.protocols.subset import AgreementWrap, BroadcastWrap
+    from hbbft_tpu.traits import Step
+
+    def rbc(epoch):
+        return HbWrap(0, SubsetWrap(epoch, BroadcastWrap(
+            0, ReadyMsg(b"\0" * 32))))
+
+    def aba(epoch, msg):
+        return HbWrap(0, SubsetWrap(epoch, AgreementWrap(0, msg)))
+
+    tracer = SpanTracer(Registry(), node=0)
+    # interleaved: epoch 0 and epoch 1 both in flight
+    tracer.on_message(1, rbc(0), t=0.0)
+    tracer.on_message(1, rbc(1), t=1.0)
+    tracer.on_message(2, aba(0, BValMsg(0, True)), t=2.0)
+    tracer.on_message(2, aba(1, BValMsg(0, True)), t=3.0)
+    tracer.on_message(3, aba(0, AuxMsg(0, True)), t=4.0)
+    tracer.on_message(3, aba(1, AuxMsg(0, False)), t=5.0)
+    # epoch 0 commits first; epoch 1 is STILL OPEN and keeps receiving
+    tracer.on_step(Step(output=[Batch(0, ())]), t=6.0)
+    tracer.on_message(2, aba(1, AuxMsg(1, True)), t=7.0)
+    tracer.on_step(Step(output=[Batch(1, ())]), t=8.0)
+
+    assert tracer.epochs_finalized == 2
+    s0 = tracer.spans_for(0, 0)
+    s1 = tracer.spans_for(0, 1)
+    # exactly one span set per epoch, one epoch-span each
+    assert sum(1 for s in s0 if s.name == "epoch") == 1
+    assert sum(1 for s in s1 if s.name == "epoch") == 1
+    # epoch 0's spans cover ONLY its own timestamps (0, 2, 4, commit 6)
+    names0 = {(s.name, s.round): s for s in s0}
+    assert set(names0) == {("rbc_ready", None), ("aba_bval", 0),
+                           ("aba_aux", 0), ("epoch", None)}
+    assert names0[("epoch", None)].t_start == 0.0
+    assert names0[("epoch", None)].t_end == 6.0
+    assert names0[("aba_bval", 0)].t_start == 2.0
+    assert all(s.t_end <= 6.0 for s in s0)
+    # epoch 1's spans cover only its own (1, 3, 5, 7, commit 8) — the
+    # post-commit-of-epoch-0 Aux at t=7 landed in round 1 of epoch 1
+    names1 = {(s.name, s.round): s for s in s1}
+    assert set(names1) == {("rbc_ready", None), ("aba_bval", 0),
+                           ("aba_aux", 0), ("aba_aux", 1),
+                           ("epoch", None)}
+    assert names1[("epoch", None)].t_start == 1.0
+    assert names1[("epoch", None)].t_end == 8.0
+    assert names1[("aba_aux", 1)].t_start == 7.0
+    # a straggler for a FINALIZED epoch never re-opens it
+    before = len(tracer.finished)
+    tracer.on_message(1, rbc(0), t=9.0)
+    tracer.on_step(Step(), t=9.5)
+    assert len(tracer.finished) == before
+    assert tracer.epochs_finalized == 2

@@ -1,0 +1,309 @@
+"""Per-tx causal tracing (obs.trace) + critical path (obs.critpath).
+
+The acceptance scenarios:
+
+- trace-context basics: ids derive from tx bytes alone, hop counters
+  follow the stage chain, packed tid blobs round-trip;
+- ``FlightTrace`` rides the wire registry (tag 0x95) byte-exactly;
+- two identical-seed VirtualNet runs (cost model on, TPKE on) produce
+  **byte-identical** critpath reports, reconstruct every committed tx,
+  and every reconstruction's components sum exactly to its total;
+- a real 4-node socket cluster reconstructs ≥ 99 % of committed txs
+  end-to-end, the p50 decomposition sums to within 10 % of the
+  client-measured submit→commit p50, the always-on
+  ``hbbft_pump_segment_seconds`` histogram and the ``/trace`` endpoint
+  serve, and ``obs.top --json`` snapshots the same cluster.
+"""
+
+import asyncio
+import contextlib
+import io
+import json
+import random
+
+import pytest
+
+from hbbft_tpu.obs import critpath
+from hbbft_tpu.obs.trace import (
+    STAGE_HOPS,
+    TRACE_ID_BYTES,
+    FlightTrace,
+    TraceContext,
+    iter_tids,
+    pack_tids,
+    tid_of_digest,
+    trace_id,
+)
+from hbbft_tpu.protocols import wire
+from hbbft_tpu.protocols.dynamic_honey_badger import DynamicHoneyBadger
+from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
+from hbbft_tpu.protocols.queueing_honey_badger import (
+    QueueingHoneyBadger,
+    TxInput,
+)
+from hbbft_tpu.sim import NetBuilder
+from hbbft_tpu.sim.trace import CostModel
+
+# ---------------------------------------------------------------------------
+# trace-context unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_trace_id_derives_from_tx_bytes_alone():
+    assert trace_id(b"tx-1") == trace_id(b"tx-1")
+    assert trace_id(b"tx-1") != trace_id(b"tx-2")
+    assert len(trace_id(b"tx-1")) == TRACE_ID_BYTES
+    # client side derives the same id from the sha3 digest prefix it
+    # already tracks per submitted tx
+    import hashlib
+
+    digest = hashlib.sha3_256(b"tx-1").digest()
+    assert tid_of_digest(digest) == trace_id(b"tx-1")
+
+
+def test_pack_iter_tids_roundtrip_and_truncation():
+    tids = [trace_id(b"a"), trace_id(b"b"), trace_id(b"c")]
+    blob = pack_tids(tids)
+    assert list(iter_tids(blob)) == tids
+    # a torn trailing partial id is dropped, never yielded short
+    assert list(iter_tids(blob + b"\x01\x02")) == tids
+    assert list(iter_tids(b"")) == []
+
+
+def test_stage_hops_monotone_along_the_causal_chain():
+    # submit (client) → ingress (node) → queued (pump) → commit →
+    # commit_seen (client): hop counts never decrease along the chain
+    chain = ("submit", "ingress", "queued", "commit", "commit_seen")
+    hops = [STAGE_HOPS[s] for s in chain]
+    assert hops == sorted(hops)
+    ctx = TraceContext(trace_id(b"x"), 0)
+    assert ctx.next().hop == 1 and ctx.next().tid == ctx.tid
+
+
+def test_flight_trace_wire_roundtrip():
+    rec = FlightTrace(seq=7, t=1.25, stage="commit", era=2, epoch=9,
+                      hop=3, detail="0",
+                      tids=pack_tids([trace_id(b"a"), trace_id(b"b")]))
+    enc = wire.encode_message(rec)
+    dec = wire.decode_message(enc)
+    assert dec == rec
+    assert list(iter_tids(dec.tids)) == [trace_id(b"a"), trace_id(b"b")]
+
+
+# ---------------------------------------------------------------------------
+# sim: byte-identical reports, exact reconstruction + component sums
+# ---------------------------------------------------------------------------
+
+
+def _recorded_sim_run(infos, root, n=4, txs=8):
+    net = (
+        NetBuilder(list(range(n)))
+        .cost_model(CostModel())
+        .flight(root)
+        .using_step(
+            lambda nid: QueueingHoneyBadger(
+                DynamicHoneyBadger(
+                    infos[nid], infos[nid].secret_key(),
+                    rng=random.Random(100 + nid),
+                    encryption_schedule=EncryptionSchedule.always(),
+                ),
+                batch_size=4, rng=random.Random(200 + nid),
+            )
+        )
+    )
+    for i in range(txs):
+        net.send_input(i % n, TxInput(b"cp-tx-%d" % i))
+    net.run_to_quiescence()
+    net.close_observers()
+    return net
+
+
+@pytest.fixture(scope="module")
+def sim_reports(shared_netinfo, tmp_path_factory):
+    """The SAME deterministic schedule recorded twice, independently,
+    each reduced to its critpath report."""
+    infos = shared_netinfo(4, 13)
+    reports = []
+    for tag in ("a", "b"):
+        root = str(tmp_path_factory.mktemp(f"critpath-{tag}"))
+        _recorded_sim_run(infos, root)
+        dirs = sorted(critpath.find_journal_dirs(root))
+        assert len(dirs) == 4
+        reports.append(critpath.build_report(dirs))
+    return reports
+
+
+def test_identical_seed_runs_yield_byte_identical_reports(sim_reports):
+    a, b = sim_reports
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def test_sim_reconstructs_every_committed_tx(sim_reports):
+    rep = sim_reports[0]
+    assert rep["txs_committed"] >= 8
+    assert rep["txs_reconstructed"] == rep["txs_committed"]
+    assert rep["reconstructed_fraction"] == 1.0
+    # unmatched evidence is COUNTED, and a clean sim run has none
+    um = rep["unmatched"]
+    assert um["no_ingress"] == 0 and um["no_commit"] == 0
+    assert um["unaligned_processes"] == []
+
+
+def test_components_sum_exactly_to_each_total(sim_reports):
+    rep = sim_reports[0]
+    assert rep["waterfalls"], rep
+    for row in rep["waterfalls"]:
+        total = sum(row["components"].values())
+        assert abs(total - row["total_s"]) < 1e-6, row
+        assert all(v >= 0 for v in row["components"].values()), row
+    # the percentile rows report one tx's OWN decomposition
+    for p in ("p50", "p99"):
+        doc = rep[p]
+        assert abs(sum(doc["components"].values())
+                   - doc["total_s"]) < 1e-6
+        assert doc["dominant"] in critpath.COMPONENTS
+        # an encrypted sim epoch spends real time in protocol phases
+    assert rep["p50"]["total_s"] > 0
+
+
+def test_clock_offsets_report_bounds_not_point_estimates(sim_reports):
+    rep = sim_reports[0]
+    for node, doc in rep["clock_offsets"].items():
+        assert "bound_s" in doc, node
+        # every aligned process carries a finite, nonnegative bound
+        assert doc["bound_s"] is not None and doc["bound_s"] >= 0
+    assert rep["anchor"] in rep["clock_offsets"]
+    assert rep["clock_offsets"][rep["anchor"]]["offset_s"] == 0.0
+
+
+def test_critpath_cli_renders_and_exits_zero(sim_reports, shared_netinfo,
+                                             tmp_path):
+    infos = shared_netinfo(4, 13)
+    root = str(tmp_path / "cli")
+    _recorded_sim_run(infos, root)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = critpath.main([root])
+    out = buf.getvalue()
+    assert rc == 0
+    assert "critpath: 4 journals" in out and "p50:" in out
+    # --json emits the full deterministic document
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = critpath.main([root, "--json"])
+    doc = json.loads(buf.getvalue())
+    assert rc == 0 and doc["reconstructed_fraction"] == 1.0
+
+
+def test_critpath_cli_exits_2_without_journals(tmp_path):
+    import sys
+
+    buf = io.StringIO()
+    with contextlib.redirect_stderr(buf):
+        rc = critpath.main([str(tmp_path / "nothing-here")])
+    assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# socket acceptance: end-to-end reconstruction on a real 4-node cluster
+# ---------------------------------------------------------------------------
+
+SOCKET_TIMEOUT_S = 90
+
+
+def test_socket_cluster_end_to_end_critical_path(tmp_path):
+    """The tentpole acceptance run: a real 4-node cluster with client
+    trace journaling, ≥ 99 % end-to-end reconstruction, p50 component
+    sum within 10 % of the client-measured submit→commit p50 — plus the
+    live surfaces riding the same boot: the always-on
+    ``hbbft_pump_segment_seconds`` histogram, ``/trace``, and
+    ``obs.top --json``."""
+    import os
+
+    from hbbft_tpu.net.cluster import ClusterConfig, LocalCluster
+    from hbbft_tpu.obs.http import http_get
+
+    flight_root = str(tmp_path / "flight")
+
+    async def scenario():
+        cfg = ClusterConfig(n=4, seed=23, batch_size=6,
+                            flight_dir=flight_root)
+        cluster = LocalCluster(cfg)
+        await cluster.start()
+        try:
+            client = await cluster.client(
+                0, trace_dir=os.path.join(flight_root, "client-0"))
+            txs = [b"cpsock-%03d" % i for i in range(24)]
+            for tx in txs:
+                assert await client.submit(tx) == 0
+            for tx in txs:
+                await client.wait_committed(tx, timeout_s=30)
+            pct = client.latency_percentiles()
+            host, port = cluster.metrics_addrs[0]
+            metrics = await asyncio.to_thread(http_get, host, port,
+                                              "/metrics")
+            trace_tail = await asyncio.to_thread(http_get, host, port,
+                                                 "/trace")
+            from hbbft_tpu.obs import top
+
+            targets = ",".join(
+                f"{h}:{p}" for h, p in
+                dict(cluster.metrics_addrs).values())
+
+            def run_top():
+                # worker thread: obs endpoints are served by THIS
+                # event loop, so a blocking poll here would deadlock
+                buf = io.StringIO()
+                with contextlib.redirect_stdout(buf):
+                    rc = top.main(["--targets", targets, "--json"])
+                return rc, buf.getvalue()
+
+            rc, top_out = await asyncio.to_thread(run_top)
+            return pct, metrics, trace_tail, rc, top_out
+        finally:
+            await cluster.stop()
+
+    pct, metrics, trace_tail, top_rc, top_out = asyncio.run(
+        asyncio.wait_for(scenario(), SOCKET_TIMEOUT_S))
+
+    # satellite: the pump-segment histogram is always on (no env gate)
+    assert "hbbft_pump_segment_seconds_bucket" in metrics
+    assert 'segment="queue_wait"' in metrics
+    assert 'segment="flush"' in metrics
+    # the /trace endpoint serves the causal stages live, tids in hex
+    trace_lines = [json.loads(l) for l in trace_tail.splitlines() if l]
+    assert any(d["stage"] == "ingress" for d in trace_lines)
+    assert any(d["stage"] == "commit" for d in trace_lines)
+    assert all(d["type"] == "FlightTrace" for d in trace_lines)
+    assert all(
+        all(len(t) == 2 * TRACE_ID_BYTES for t in d["tids"])
+        for d in trace_lines)
+    # satellite: obs.top one-shot JSON over the live cluster
+    assert top_rc == 0
+    top_doc = json.loads(top_out)
+    assert len(top_doc["nodes"]) == 4
+    assert all(n["up"] for n in top_doc["nodes"])
+    assert all("mesh_collectives" in n and "load" in n
+               for n in top_doc["nodes"])
+
+    # offline: merge all journals (4 nodes + 1 client) into the report
+    dirs = sorted(critpath.find_journal_dirs(flight_root))
+    assert len(dirs) == 5, dirs
+    rep = critpath.build_report(dirs)
+    assert rep["clients"] == ["client"]
+    # ≥ 99 % of committed txs reconstruct end to end
+    assert rep["reconstructed_fraction"] >= 0.99, rep["unmatched"]
+    # every reconstructed tx has the full client→client chain
+    assert rep["unmatched"]["no_commit_seen"] == 0, rep["unmatched"]
+    # the p50 decomposition sums to the p50 total exactly, and that
+    # total agrees with the CLIENT-measured submit→commit p50 within
+    # 10 % (different clocks, same two events)
+    p50 = rep["p50"]
+    assert abs(sum(p50["components"].values()) - p50["total_s"]) < 1e-6
+    measured = pct["p50_s"]
+    assert measured > 0
+    assert abs(p50["total_s"] - measured) <= 0.10 * max(
+        measured, p50["total_s"]) + 2e-3, (p50["total_s"], measured)
+    # a real-socket run spends most of its budget outside the client
+    # wire hop; the dominant edge must be a protocol-side component
+    assert p50["dominant"] in critpath.COMPONENTS

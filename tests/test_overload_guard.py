@@ -442,7 +442,9 @@ def test_mempool_shed_reaches_protocol_queue(guard_runtime):
     shed_events = []
     while rt.pump._inbox:
         shed_events.append(rt.pump._inbox.popleft())
-    assert any(k == "shed" for k, _a in shed_events)
+    # inbox entries are (kind, args, t_enq) since the pump started
+    # stamping queue-wait times
+    assert any(ev[0] == "shed" for ev in shed_events)
     rt.pump_process([e for e in shed_events if e[0] == "shed"], depth=1)
     assert len(queue) == before - 1
     assert hog_txs[0] not in queue._set

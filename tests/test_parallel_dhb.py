@@ -62,8 +62,12 @@ def test_remove_validator_rotates_era_and_new_era_commits(dkg_remove_run):
     assert b1.era == 1 and dict(b1.contributions) == run["era1_contribs"]
 
 
+@pytest.mark.slow
 def test_add_validator_via_dkg(dkg_add_run):
-    run = dkg_add_run  # ONE shared rotation (conftest session fixture)
+    # ONE shared rotation (conftest session fixture) — and this test is
+    # its only consumer, so tiering it out drops the fixture's ~106 s
+    # too.  The remove rotation + cross-mode equality pair stays tier 1.
+    run = dkg_add_run
     final = run["final"]
     assert sorted(final.change.change.key_map()) == [0, 1, 2, 3, 4]
     assert run["era"] == 1
@@ -94,6 +98,7 @@ def test_encryption_schedule_change_no_dkg():
     assert b1.era == 1
 
 
+@pytest.mark.slow
 def test_missing_candidate_key_raises_recoverably(shared_netinfo):
     """A winning add-vote whose candidate key the god view lacks raises,
     but must not half-start the change (change_state stays none, so
@@ -192,6 +197,7 @@ def test_cross_mode_remove_matches_object_network(
         assert obj_map[key] == arr_map[key], key
 
 
+@pytest.mark.slow
 def test_queueing_over_dynamic_membership(shared_netinfo):
     """The composed top-of-stack driver: transactions drain across an era
     boundary while a validator is voted out mid-run; every tx in a

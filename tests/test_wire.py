@@ -205,7 +205,15 @@ def _flight_samples():
         FlightSpan(11, 11.0, "aba_bval", 0, 3, 2, 1.5, 2.5, 12),
         FlightSpan(12, 12.0, "epoch", 0, 3, None, 1.0, 3.0, 60),
         FlightNote(13, 13.0, "replay_gap", "peer=3"),
+        _trace_sample(),
     ]
+
+
+def _trace_sample():
+    from hbbft_tpu.obs.trace import FlightTrace, pack_tids, trace_id
+
+    return FlightTrace(14, 14.0, "ingress", 0, 3, 1, "0",
+                       pack_tids([trace_id(b"tx-a"), trace_id(b"tx-b")]))
 
 
 def _sync_samples():
