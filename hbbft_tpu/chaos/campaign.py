@@ -61,9 +61,11 @@ from hbbft_tpu.sim.adversary import (
     FloodAdversary,
     FutureEpochSpamAdversary,
     GarbageStreamAdversary,
+    IdentitySpoofAdversary,
     MitmDelayAdversary,
     NullAdversary,
     ReorderingAdversary,
+    SpoofReplayAdversary,
     VoteStormAdversary,
 )
 from hbbft_tpu.sim.trace import CostModel
@@ -114,7 +116,11 @@ class CellSpec:
     def faulty(self) -> Tuple[int, ...]:
         """Byzantine node set implied by the adversary (the equivocator
         needs a faulty sender for tamper() to apply to; the flood /
-        window-spam adversaries act under the last node's identity)."""
+        window-spam adversaries act under the last node's identity).
+        Spoof adversaries return (): their victim genuinely sent the
+        replayed traffic once and must NOT be pre-blamed — mis-blaming
+        the impersonated node is exactly the failure those cells
+        exist to catch."""
         if self.adversary in ("equivocate", "flood", "future-spam"):
             return (self.n - 1,)
         return ()
@@ -130,12 +136,15 @@ class CellSpec:
 
 #: the adversary zoo, by campaign name.  "flood" and "future-spam" are
 #: the overload-defense drills (valid-frame spam amplification and
-#: window-edge protocol spam); their socket siblings ("garbage-stream"
-#: and "flood" at kind "socket") drive a REAL cluster via raw-socket
-#: injectors instead of the simulator hooks.
+#: window-edge protocol spam); "spoof-replay" is the identity-theft
+#: analog the authenticated transport leaves possible in-sim (replayed
+#: duplicates of an HONEST victim's own traffic — the victim must not
+#: be blamed).  Their socket siblings ("garbage-stream" / "flood" /
+#: the "spoof-*" modes at kind "socket") drive a REAL cluster via
+#: raw-socket injectors instead of the simulator hooks.
 ADVERSARIES: Tuple[str, ...] = (
     "null", "reorder", "mitm-delay", "censor-ready", "eclipse", "crash",
-    "equivocate", "vote-storm", "flood", "future-spam",
+    "equivocate", "vote-storm", "flood", "future-spam", "spoof-replay",
 )
 
 #: per-preset sim time scale: presets are written in real seconds, cells
@@ -187,6 +196,12 @@ def make_adversary(spec: CellSpec):
         # window-edge protocol spam: the receivers' future-epoch
         # budgets and buffer caps must absorb it, counted
         return FutureEpochSpamAdversary(spammer=n - 1, seed=seed)
+    if name == "spoof-replay":
+        # replayed duplicates of node 0's own genuine traffic: the
+        # strongest spoof the authenticated transport leaves possible.
+        # Duplicates are protocol no-ops; node 0 stays HONEST (not in
+        # spec.faulty) and the cell must audit clean
+        return SpoofReplayAdversary(victim=0, seed=seed)
     raise ValueError(f"unknown adversary {name!r} "
                      f"(known: {', '.join(ADVERSARIES)})")
 
@@ -442,8 +457,14 @@ def run_churn_cell(spec: CellSpec, cell_dir: str
 
 
 #: socket-kind adversaries driven by raw-socket injectors (everything
-#: else in the zoo is a simulator adversary)
+#: else in the zoo is a simulator adversary).  The flood injectors
+#: model a COMPROMISED validator (they hold its real key, so the
+#: authenticated handshake completes and the flood drill proceeds);
+#: the spoof injectors claim a correct validator's id WITHOUT its key
+#: and must be refused at the challenge, zero frames in.
 SOCKET_FLOOD_ADVERSARIES = ("garbage-stream", "flood")
+SOCKET_SPOOF_ADVERSARIES = ("spoof-nokey", "spoof-wrongkey",
+                            "spoof-hijack", "spoof-downgrade")
 
 
 async def _socket_scenario(spec: CellSpec, cell_dir: str
@@ -454,12 +475,19 @@ async def _socket_scenario(spec: CellSpec, cell_dir: str
     clean — the pipelined liveness point of the chaos trajectory.
 
     With a flood adversary (``garbage-stream`` / ``flood``), a
-    raw-socket injector claiming the LAST validator's identity floods
-    node 0 while the cell's client traffic flows: the cluster must keep
-    committing, every budgeted buffer gauge must stay under its cap
-    (sampled live throughout the flood), and the guard's counted
-    throttles/disconnects must attribute the incident to the claimed
-    identity in the audit."""
+    raw-socket injector holding the LAST validator's REAL key (the
+    compromised-validator model) floods node 0 while the cell's client
+    traffic flows: the cluster must keep committing, every budgeted
+    buffer gauge must stay under its cap (sampled live throughout the
+    flood), and the guard's counted throttles/disconnects must
+    attribute the incident to the claimed identity in the audit.
+
+    With a spoof adversary (``spoof-*``), the injector claims the last
+    validator's identity WITHOUT its key: every hello must be refused
+    at the challenge (zero accepted, counted under
+    ``hbbft_guard_auth_failures_total``), the impersonated validator
+    must accrue no budget debt or strikes, and the audit must name the
+    ATTACKER's endpoint — never the victim — in its incidents."""
     import asyncio
     import contextlib
     import time
@@ -468,9 +496,11 @@ async def _socket_scenario(spec: CellSpec, cell_dir: str
         ClusterConfig,
         LocalCluster,
         find_free_base_port,
+        node_secret_key,
     )
 
     flooding = spec.adversary in SOCKET_FLOOD_ADVERSARIES
+    spoofing = spec.adversary in SOCKET_SPOOF_ADVERSARIES
     cfg = ClusterConfig(
         n=spec.n, seed=spec.seed, batch_size=spec.batch_size,
         base_port=find_free_base_port(spec.n),
@@ -522,12 +552,35 @@ async def _socket_scenario(spec: CellSpec, cell_dir: str
     sampler = None
     try:
         if flooding:
+            # the flood injector holds the claimed validator's REAL
+            # key (compromised-validator model): the authenticated
+            # handshake completes and the ingress-budget drill runs
+            # exactly as before auth existed
             injector = GarbageStreamAdversary(
                 seed=spec.seed,
-                valid_frames=(spec.adversary == "flood"))
+                valid_frames=(spec.adversary == "flood"),
+                secret_key=node_secret_key(cfg, spec.n - 1))
             injector_task = asyncio.ensure_future(injector.run(
                 cluster.addrs[0], cfg.cluster_id, identity=spec.n - 1,
                 duration_s=20.0))
+        elif spoofing:
+            mode = spec.adversary[len("spoof-"):]
+            # wrongkey/downgrade sign the genuine transcript with a key
+            # that is NOT the claimed validator's — deterministically
+            # derived, guaranteed outside the cluster's key map
+            from hbbft_tpu.crypto import tc
+            wrong = (tc.SecretKey.random(
+                random.Random(spec.seed * 7919 + 123))
+                if mode in ("wrongkey", "downgrade") else None)
+            # the downgrade probe claims a NON-current era (the cell
+            # never rotates, so any era != 0 drives the stale-era /
+            # mismatch verification path the grace window gates)
+            injector = IdentitySpoofAdversary(
+                seed=spec.seed, mode=mode, secret_key=wrong,
+                claim_era=3 if mode == "downgrade" else 0)
+            injector_task = asyncio.ensure_future(injector.run(
+                cluster.addrs[0], cfg.cluster_id, identity=spec.n - 1,
+                duration_s=8.0))
         sampler = asyncio.ensure_future(sample_gauges())
         client = await cluster.client(
             0, trace_dir=os.path.join(cell_dir, "client-0"))
@@ -548,7 +601,10 @@ async def _socket_scenario(spec: CellSpec, cell_dir: str
         wall = time.monotonic() - t0
         await cluster.wait_epochs(min_batches=1, timeout_s=60)
         if injector_task is not None:
-            injector.budget_frames = 0  # stop flooding, then join
+            if flooding:
+                injector.budget_frames = 0  # stop flooding, then join
+            else:
+                injector.budget_attempts = 0
             await asyncio.wait_for(injector_task, 30.0)
             injector_task = None
         prefix = cluster.common_digest_prefix()
@@ -578,6 +634,41 @@ async def _socket_scenario(spec: CellSpec, cell_dir: str
                     "frames_sent": injector.frames_sent,
                     "bytes_sent": injector.bytes_sent,
                     "disconnects_observed": injector.disconnects,
+                },
+            }
+        elif spoofing:
+            victim_ingress = cluster.runtimes[0].transport.ingress
+            doc = victim_ingress.as_dict()
+            auth_refused = sum(doc["auth_failures"].values())
+            # the spoof-proof contract, asserted live: zero spoofed
+            # hellos accepted, every attempt refused AND counted, and
+            # the IMPERSONATED validator's budget record stays
+            # strike-free (its genuine peer connection keeps working)
+            if injector.hellos_accepted:
+                raise AssertionError(
+                    f"spoofed hello ACCEPTED "
+                    f"({injector.hellos_accepted} of "
+                    f"{injector.attempts} attempts)")
+            if injector.attempts and not auth_refused:
+                raise AssertionError(
+                    "spoof attempts were made but no auth failure "
+                    "was counted")
+            victim_peer = doc["peers"].get(repr(spec.n - 1), {})
+            if (victim_peer.get("strikes", 0)
+                    or victim_peer.get("decode_fails", 0)):
+                raise AssertionError(
+                    "spoof attempt charged the IMPERSONATED "
+                    f"validator's budget record: {victim_peer}")
+            out["guard"] = {
+                "auth_failures": doc["auth_failures"],
+                "auth_refused": auth_refused,
+                "auth_ok": doc["auth_ok"],
+                "impersonated_peer_doc": victim_peer,
+                "injector": {
+                    "mode": injector.mode,
+                    "attempts": injector.attempts,
+                    "refusals_observed": injector.refusals,
+                    "hellos_accepted": injector.hellos_accepted,
                 },
             }
         return out
@@ -637,7 +728,7 @@ def full_grid(seeds: Sequence[int] = (0, 1),
             for adv in ADVERSARIES:
                 limit = 40_000
                 if adv in ("equivocate", "vote-storm", "flood",
-                           "future-spam"):
+                           "future-spam", "spoof-replay"):
                     # never-draining queues (equivocator re-proposals) /
                     # multi-rotation storms / injected spam waves need
                     # the longer leash
@@ -674,6 +765,16 @@ def full_grid(seeds: Sequence[int] = (0, 1),
     # under its cap, and the audit attributes the incident to the
     # claimed peer from the journaled guard events
     for adv in SOCKET_FLOOD_ADVERSARIES:
+        specs.append(CellSpec(kind="socket", shape="none",
+                              adversary=adv, n=4, seed=0,
+                              pipeline_depth=2))
+    # socket identity-spoof cells (authenticated transport, end to
+    # end): a raw-socket injector claims a correct validator's id
+    # WITHOUT its key, in each refusal mode — every hello must die at
+    # the challenge (zero frames into the protocol), the impersonated
+    # validator's budget record stays clean, and the audit names the
+    # attacker's endpoint
+    for adv in SOCKET_SPOOF_ADVERSARIES:
         specs.append(CellSpec(kind="socket", shape="none",
                               adversary=adv, n=4, seed=0,
                               pipeline_depth=2))

@@ -46,6 +46,11 @@ from hbbft_tpu.lint.core import Checker, Finding, ModuleSource, register
 _NET_PARAMS = frozenset({
     "sender_id", "sender", "peer_id", "peer", "payload", "data",
     "message", "msg", "frame", "hello", "conn", "tx",
+    # the authenticated-handshake surface: everything a dialer hands
+    # the acceptor BEFORE it is verified is network-derived input, and
+    # anything grown from it pre-verification is a pre-auth memory
+    # lever (the half-open budget only caps concurrency, not state)
+    "nonce", "session", "sig", "signature", "auth",
 })
 
 #: the subset of network parameters that are peer IDENTITIES — only

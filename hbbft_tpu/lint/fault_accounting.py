@@ -30,7 +30,14 @@ a swallowed exception is an invisible Byzantine symptom.
   send/receive pairs that never match, trace stages that never pair
   up, and unalignable processes are *counted* in the report's
   ``unmatched`` section — an attribution tool that silently drops the
-  evidence it couldn't attribute would be worse than none.
+  evidence it couldn't attribute would be worse than none.  The
+  authenticated handshake extends the contract to identity refusals:
+  every hello the acceptor turns away must increment
+  ``hbbft_guard_auth_failures_total`` under its reason label
+  (``bad_sig`` / ``unknown_key`` / ``no_auth`` / ``malformed`` /
+  ``timeout`` / ``session`` / ``half_open``) and journal the
+  attacker's endpoint — a spoof attempt that vanishes without a
+  counter is an attack rehearsal nobody will see coming.
 """
 
 from __future__ import annotations

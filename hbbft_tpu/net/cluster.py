@@ -114,6 +114,18 @@ class ClusterConfig:
     ingress_max_inflight: int = 0
     ingress_decode_strikes: int = 0
     ingress_throttle_strikes: int = 0
+    # transport authentication (net/transport.py security model):
+    # node-role hellos are challenge–response proven with the per-era
+    # keys; auth=False reverts to the identification-only legacy
+    # handshake (trusted-fabric benchmarks, protocol archaeology).
+    # auth_grace_s bounds the previous-era key window during DKG
+    # rotations (counted hbbft_guard_auth_stale_era_total).
+    auth: bool = True
+    auth_grace_s: float = 30.0
+    # guard-driven adaptive degradation (net/degrade.py): shrink the
+    # proposed batch size / mempool admission under sustained guard
+    # pressure instead of riding the buffers into their cliff-edge caps
+    degrade: bool = True
     # class-selective shaping: the listed nodes ("0,1") hold their
     # outbound BINARY-AGREEMENT traffic (BVal/Aux/Conf/Coin/Term) for
     # `aba_out_delay_s` while RBC flows normally.  Decorrelating ABA
@@ -239,6 +251,21 @@ def node_secret_key(cfg: ClusterConfig, nid: int,
         random.Random(cfg.seed * 100_000 + 9000 + nid))
 
 
+def donor_key_fn(cfg: ClusterConfig):
+    """Donor-authentication resolver for state-sync joins: donor node
+    id -> config-derived plain public key (genesis members and derived
+    joiners alike), ``None`` for anything else — an unknown id fails
+    the statesync identity challenge instead of being trusted."""
+    infos = generate_infos(cfg)
+
+    def key(nid):
+        if isinstance(nid, int) and 0 <= nid:
+            return node_secret_key(cfg, nid, infos).public_key()
+        return None
+
+    return key
+
+
 def peer_addr_book(cfg: ClusterConfig):
     """The deployment address book: membership says WHO may join
     (consensus state); this says WHERE a member listens (config-derived
@@ -290,6 +317,9 @@ def _shared_runtime_kwargs(cfg: ClusterConfig, nid: int) -> dict:
         aba_out_delay_s=cfg.aba_delay_for(nid),
         aba_out_classes=cfg.aba_out_classes,
         ingress_kwargs=cfg.ingress_kwargs(),
+        auth=cfg.auth,
+        auth_grace_s=cfg.auth_grace_s,
+        degrade=cfg.degrade,
     )
 
 
@@ -483,6 +513,7 @@ class LocalCluster:
             client_id=f"statesync-{nid}", seed=self.cfg.seed,
             min_manifest_confirm=min_manifest_confirm,
             registry=registry,
+            donor_key=donor_key_fn(self.cfg) if self.cfg.auth else None,
         ).fetch()
         kwargs = dict(self.runtime_kwargs)
         kwargs["registry"] = registry
@@ -618,6 +649,12 @@ def node_command(cfg: ClusterConfig, nid: int) -> List[str]:
         cmd += ["--chaos", cfg.chaos]
         if cfg.chaos_seed >= 0:
             cmd += ["--chaos-seed", str(cfg.chaos_seed)]
+    if not cfg.auth:
+        cmd.append("--no-auth")
+    if cfg.auth_grace_s != 30.0:
+        cmd += ["--auth-grace-s", str(cfg.auth_grace_s)]
+    if not cfg.degrade:
+        cmd.append("--no-degrade")
     if cfg.step_delay_for(nid) > 0:
         cmd += ["--step-delay", str(cfg.step_delay_for(nid))]
     if cfg.aba_delay_for(nid) > 0:
@@ -728,6 +765,7 @@ async def run_join_node(cfg: ClusterConfig, nid: int,
         [cfg.addr(d) for d in donor_ids], cfg.cluster_id,
         client_id=f"statesync-{nid}", seed=cfg.seed,
         min_manifest_confirm=min(min_manifest_confirm, len(donor_ids)),
+        donor_key=donor_key_fn(cfg) if cfg.auth else None,
     ).fetch()
     print(f"node {nid} state-synced era {snap.era} snapshot "
           f"(chain len {snap.chain_len})", flush=True)
@@ -863,6 +901,17 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--aba-out-classes", default="",
                     help="narrow --aba-out-delay to these phase classes "
                          "(comma list, e.g. aba_conf); empty = all aba_*")
+    ap.add_argument("--no-auth", action="store_true",
+                    help="disable the authenticated node handshake "
+                         "(identification-only hellos — trusted "
+                         "fabrics only)")
+    ap.add_argument("--auth-grace-s", type=float, default=30.0,
+                    help="previous-era key grace window during DKG "
+                         "rotations, seconds")
+    ap.add_argument("--no-degrade", action="store_true",
+                    help="disable guard-driven adaptive degradation "
+                         "(batch-size/mempool shrink under sustained "
+                         "overload)")
     ap.add_argument("--join", action="store_true",
                     help="join a LIVE cluster via snapshot state-sync "
                          "instead of starting from genesis: the "
@@ -889,6 +938,9 @@ def main(argv: Optional[List[str]] = None) -> None:
                          else ""),
         aba_out_delay_s=args.aba_out_delay,
         aba_out_classes=args.aba_out_classes,
+        auth=not args.no_auth,
+        auth_grace_s=args.auth_grace_s,
+        degrade=not args.no_degrade,
     )
     if args.join:
         asyncio.run(run_join_node(cfg, args.node_id,

@@ -25,9 +25,11 @@ from hbbft_tpu.protocols import wire
 
 MAGIC = b"HBTN"
 # v2: MSG_BATCH coalesced consensus frames (epoch-pipelined runtime).
+# v3: authenticated node-role handshake (CHALLENGE/AUTH) — a node hello
+# is now *proven* with a per-era key signature, not merely claimed.
 # The hello's version check turns a mixed-version cluster into a clean
 # handshake error instead of mid-stream frame-kind surprises.
-PROTOCOL_VERSION = 2
+PROTOCOL_VERSION = 3
 
 # Frame cap: one frame carries at most one wire message (itself capped at
 # wire.MAX_MESSAGE_BYTES) plus the kind byte; the hello/control frames are
@@ -49,11 +51,17 @@ MSG_BATCH = 0x0A   # several MSG payloads coalesced into one frame
 SYNC = 0x0B        # snapshot state-sync record (net/statesync.py), both
                    # directions on a client-role connection; payload is
                    # wire.encode_message bytes of a Sync* record
+CHALLENGE = 0x0C   # verifier → prover: random nonce + session id the
+                   # prover must sign (node-role handshake; also sent by
+                   # a statesync joiner to authenticate its donor)
+AUTH = 0x0D        # prover → verifier: u64 era + blob(signature) over
+                   # auth_transcript(...) by the prover's per-era key
 
 KIND_NAMES = {
     HELLO: "HELLO", MSG: "MSG", PING: "PING", PONG: "PONG", TX: "TX",
     TX_ACK: "TX_ACK", TX_COMMIT: "TX_COMMIT", STATUS_REQ: "STATUS_REQ",
     STATUS: "STATUS", MSG_BATCH: "MSG_BATCH", SYNC: "SYNC",
+    CHALLENGE: "CHALLENGE", AUTH: "AUTH",
 }
 
 # TX_ACK status bytes
@@ -67,6 +75,27 @@ ACK_SHED = 4       # push notification: a previously-ACCEPTED tx was
 
 ROLE_NODE = 0x01
 ROLE_CLIENT = 0x02
+
+# -- authenticated handshake (v3) --------------------------------------------
+#
+# The node-role hello is identification; the CHALLENGE/AUTH exchange is
+# authentication.  The verifier issues a random nonce + session id; the
+# prover signs auth_transcript(...) — which binds the cluster id, the
+# nonce, the session, and the hello header material (claimed id, role,
+# era) — with its per-era secret key.  The session id is then bound into
+# every subsequent heartbeat PING on the connection, so a hijacked TCP
+# stream cannot ride an already-authenticated session.  All handshake
+# frames fit under MAX_HANDSHAKE_FRAME: the half-open byte budget — a
+# dialer cannot make the verifier buffer a large frame before it proves
+# anything.
+
+#: byte budget for any single pre-auth handshake frame (hello /
+#: challenge / auth); generous for every legitimate encoding, tiny
+#: against the transport's MiB-scale steady-state frame cap
+MAX_HANDSHAKE_FRAME = 4096
+
+NONCE_LEN = 32     # server-issued random challenge nonce
+SESSION_LEN = 8    # per-connection session id, echoed in heartbeats
 
 
 class FrameError(ValueError):
@@ -195,16 +224,79 @@ async def read_one_frame(reader, max_frame: int = DEFAULT_MAX_FRAME
     return body[0], body[1:]
 
 
+def auth_transcript(cluster_id: bytes, nonce: bytes, session: bytes,
+                    node_id, role: int, era: int) -> bytes:
+    """The exact bytes an authenticating peer signs: domain tag, cluster
+    id, the verifier's random nonce + session id, and the hello header
+    material (claimed node id, role, the era whose key signs).  Both
+    sides derive it independently — nothing signature-relevant ever
+    travels only one way."""
+    if len(nonce) != NONCE_LEN or len(session) != SESSION_LEN:
+        raise FrameError("bad challenge nonce/session length")
+    return (
+        b"hbbft-auth/3"
+        + wire.blob(cluster_id)
+        + nonce
+        + session
+        + wire.node_id(node_id)
+        + bytes([role])
+        + wire.u64(era)
+    )
+
+
+def encode_challenge(nonce: bytes, session: bytes) -> bytes:
+    if len(nonce) != NONCE_LEN or len(session) != SESSION_LEN:
+        raise FrameError("bad challenge nonce/session length")
+    return nonce + session
+
+
+def decode_challenge(payload: bytes) -> Tuple[bytes, bytes]:
+    if len(payload) != NONCE_LEN + SESSION_LEN:
+        raise FrameError(
+            f"challenge payload of {len(payload)} bytes "
+            f"(want {NONCE_LEN + SESSION_LEN})"
+        )
+    return payload[:NONCE_LEN], payload[NONCE_LEN:]
+
+
+def encode_auth(era: int, sig: bytes) -> bytes:
+    return wire.u64(era) + wire.blob(sig)
+
+
+def decode_auth(payload: bytes) -> Tuple[int, bytes]:
+    r = wire.Reader(payload)
+    try:
+        era = r.u64()
+        sig = r.blob()
+        if not r.done():
+            raise FrameError("trailing bytes after auth record")
+    except ValueError as exc:
+        if isinstance(exc, FrameError):
+            raise
+        raise FrameError(f"malformed auth record: {exc}") from exc
+    return era, sig
+
+
 async def client_hello_handshake(
     addr, cluster_id: bytes, client_id, *,
     timeout_s: float, max_frame: int = DEFAULT_MAX_FRAME,
+    verify_node=None, challenge_rng=None,
 ):
     """Dial ``addr``, perform the client-role HELLO exchange, and return
     ``(reader, writer, node_hello)`` — the one handshake shared by every
     client-side connection (``ClusterClient``, the state-sync joiner).
     Raises :class:`FrameError` on a non-HELLO reply or cluster-id
-    mismatch; timeouts/connection errors propagate."""
+    mismatch; timeouts/connection errors propagate.
+
+    ``verify_node`` authenticates the NODE to the client (the statesync
+    joiner's donor check): a callable ``(node_id, era, sig, transcript)
+    -> bool``.  When given, the client issues a CHALLENGE after the hello
+    exchange and the node must answer a valid AUTH signed by its per-era
+    key — an impersonated donor fails loudly here, before a single sync
+    byte is trusted.  ``challenge_rng`` (a ``random.Random``) seeds the
+    nonce for deterministic tests; default is OS entropy."""
     import asyncio
+    import os
 
     reader, writer = await asyncio.wait_for(
         asyncio.open_connection(*addr), timeout_s
@@ -222,6 +314,29 @@ async def client_hello_handshake(
         node_hello = decode_hello(payload)
         if node_hello.cluster_id != cluster_id:
             raise FrameError("cluster id mismatch")
+        if verify_node is not None:
+            if challenge_rng is not None:
+                blob = challenge_rng.randbytes(NONCE_LEN + SESSION_LEN)
+            else:
+                blob = os.urandom(NONCE_LEN + SESSION_LEN)
+            nonce, session = blob[:NONCE_LEN], blob[NONCE_LEN:]
+            writer.write(encode_frame(
+                CHALLENGE, encode_challenge(nonce, session), max_frame))
+            await writer.drain()
+            kind, payload = await asyncio.wait_for(
+                read_one_frame(reader, MAX_HANDSHAKE_FRAME), timeout_s
+            )
+            if kind != AUTH:
+                raise FrameError("node did not answer the challenge")
+            era, sig = decode_auth(payload)
+            transcript = auth_transcript(
+                cluster_id, nonce, session,
+                node_hello.node_id, ROLE_NODE, era)
+            if not verify_node(node_hello.node_id, era, sig, transcript):
+                raise FrameError(
+                    f"node {node_hello.node_id!r} failed the donor "
+                    f"authentication challenge"
+                )
     except BaseException:
         writer.close()
         raise

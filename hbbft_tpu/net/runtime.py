@@ -49,9 +49,10 @@ from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
 
 from hbbft_tpu.net import framing
 from hbbft_tpu.net.client import Mempool, tx_digest
+from hbbft_tpu.net.degrade import attach_runtime as _attach_degrade
 from hbbft_tpu.net.scheduler import StepPump
 from hbbft_tpu.net.statesync import SnapshotStore
-from hbbft_tpu.net.transport import ClientConn, Transport
+from hbbft_tpu.net.transport import ClientConn, EraKeyRing, Transport
 from hbbft_tpu.snapshot import capture_join_snapshot
 from hbbft_tpu.obs.flight import FlightObserver, FlightRecorder
 from hbbft_tpu.obs.http import ObsServer
@@ -143,6 +144,10 @@ class NodeRuntime:
         step_delay_s: float = 0.0,
         aba_out_delay_s: float = 0.0,
         aba_out_classes: str = "",
+        auth: bool = True,
+        auth_grace_s: float = 30.0,
+        degrade: bool = True,
+        degrade_kwargs: Optional[Dict[str, Any]] = None,
         **transport_kwargs,
     ):
         self.sq = algo if isinstance(algo, SenderQueue) else SenderQueue(algo)
@@ -173,7 +178,11 @@ class NodeRuntime:
         self.aba_out_classes = frozenset(
             c.strip() for c in aba_out_classes.split(",") if c.strip()
         )
-        self.pump = StepPump(self, pipeline_depth=self.pipeline_depth)
+        # tick_s: the degradation controller needs periodic pump wakes
+        # to recover on an idle node (see StepPump); without the
+        # controller the pump stays purely event-driven
+        self.pump = StepPump(self, pipeline_depth=self.pipeline_depth,
+                             tick_s=0.25 if degrade else None)
         self._out: Optional[_PumpOutcome] = None
         # park threshold-decrypt share verification in the protocols so
         # the pump can resolve ALL in-flight epochs' sets in one merged
@@ -335,6 +344,18 @@ class NodeRuntime:
         self._replay_seen: Dict[NodeId, set] = {}
         self._replay_bytes: Dict[NodeId, int] = {}
         self._clients: set = set()
+        # transport authentication (see transport module security
+        # model): the per-era keypairs the protocol already carries
+        # become the handshake's WHO.  Wired whenever the wrapped stack
+        # exposes a NetworkInfo; a bare test harness without one keeps
+        # the legacy identification-only handshake, as does auth=False.
+        self._cluster_id = bytes(cluster_id)
+        self._era_keys: Optional[EraKeyRing] = None
+        if auth and self._auth_netinfo() is not None:
+            self._era_keys = EraKeyRing(self._era_key_provider,
+                                        grace_s=auth_grace_s)
+            transport_kwargs.setdefault("auth_sign", self._auth_sign)
+            transport_kwargs.setdefault("auth_verify", self._auth_verify)
         self.transport = Transport(
             our_id=self.sq.our_id(),
             cluster_id=cluster_id,
@@ -405,6 +426,95 @@ class NodeRuntime:
             self._pump_record = open(
                 os.path.join(rec_dir,
                              f"events-{self.sq.our_id()!r}.jsonl"), "w")
+        # guard-driven adaptive degradation (net/degrade.py): shrink the
+        # proposed batch size and mempool admission under sustained
+        # guard pressure, restore when it clears.  None when the wrapped
+        # protocol exposes no batch size (nothing to degrade) or
+        # degrade=False.
+        self.degrade = (_attach_degrade(self, **(degrade_kwargs or {}))
+                        if degrade else None)
+
+    # -- transport authentication --------------------------------------------
+
+    def _auth_netinfo(self):
+        """The NetworkInfo carrying this era's keypairs, if the wrapped
+        stack has one (DynamicHoneyBadger or plain HoneyBadger)."""
+        dhb = self._inner_dhb()
+        if dhb is not None:
+            return dhb.netinfo
+        return getattr(self._inner_hb(), "netinfo", None)
+
+    def _era_key_provider(self) -> Tuple[int, Dict[NodeId, Any]]:
+        """EraKeyRing source: the CURRENT era's plain public-key map —
+        the same map the dynamic-peer resolver consults for membership."""
+        era, _epoch = self.current_key()
+        ni = self._auth_netinfo()
+        return int(era), (dict(ni.public_key_map())
+                          if ni is not None else {})
+
+    def _auth_sign(self, cluster_id: bytes, nonce: bytes,
+                   session: bytes) -> Tuple[int, bytes]:
+        """Answer a handshake CHALLENGE: sign the transcript with this
+        node's current per-era secret key (transport auth callback)."""
+        ni = self._auth_netinfo()
+        if ni is None:
+            raise framing.FrameError(
+                "challenged but this node carries no era keypair")
+        era, _epoch = self.current_key()
+        transcript = framing.auth_transcript(
+            cluster_id, nonce, session, self.our_id(),
+            framing.ROLE_NODE, int(era))
+        return int(era), ni.secret_key().sign(transcript).to_bytes()
+
+    def _auth_verify(self, node_id: NodeId, role: int, era: int,
+                     sig_bytes: bytes, nonce: bytes,
+                     session: bytes) -> str:
+        """Judge an inbound handshake proof (transport auth callback):
+        ``ok`` / ``stale`` (previous-era key inside the rotation grace
+        window, or an honest-but-behind era claim under a current key)
+        / ``bad_sig`` / ``unknown_key``."""
+        from hbbft_tpu.crypto import tc
+
+        try:
+            sig = tc.Signature.from_bytes(bytes(sig_bytes))
+            transcript = framing.auth_transcript(
+                self._cluster_id, nonce, session, node_id, role,
+                int(era))
+        # hblint: disable=fault-swallowed-drop (the verdict return IS
+        # the accounting: the transport counts every non-ok verdict
+        # under hbbft_guard_auth_failures_total{reason=...} and
+        # journals the endpoint)
+        except (ValueError, IndexError, framing.FrameError):
+            # IndexError: pairing libs raise it on empty/truncated
+            # signature blobs rather than ValueError
+            return "bad_sig"
+        candidates = self._era_keys.lookup(node_id)
+        if not candidates:
+            return "unknown_key"
+        era_matched = False
+        for cand_era, key, stale in candidates:
+            if cand_era != era:
+                continue
+            era_matched = True
+            if key.verify(sig, transcript):
+                return "stale" if stale else "ok"
+        if not era_matched:
+            # an honest peer behind on rotations signs its own (older)
+            # era view; a signature by a CURRENT-map key still proves
+            # key possession — admit as stale (counted), or a restarted
+            # validator could never reconnect.  A revoked key holder
+            # still fails: its key is in no admissible map.
+            for _cand_era, key, stale in candidates:
+                if not stale and key.verify(sig, transcript):
+                    return "stale"
+        return "bad_sig"
+
+    def pump_tick(self) -> None:
+        """Periodic pump heartbeat (between iterations, serialized with
+        pump_process): drives the degradation controller so engage AND
+        recovery both proceed whether the node is busy or idle."""
+        if self.degrade is not None:
+            self.degrade.tick()
 
     # -- observability -------------------------------------------------------
     #
@@ -1285,6 +1395,8 @@ class NodeRuntime:
                 },
                 "mempool_sheds": dict(self.mempool.sheds),
             },
+            "degraded": (self.degrade.as_dict()
+                         if self.degrade is not None else None),
             "faults_observed": self.faults_observed,
             "peers_connected": sum(
                 1 for p in self.transport.peer_ids()

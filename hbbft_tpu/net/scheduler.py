@@ -77,12 +77,21 @@ class StepPump:
     """
 
     def __init__(self, runtime: Any, *, pipeline_depth: int = 1,
-                 max_batch: int = DEFAULT_MAX_BATCH):
+                 max_batch: int = DEFAULT_MAX_BATCH,
+                 tick_s: Optional[float] = None):
         if pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1")
+        if tick_s is not None and tick_s <= 0:
+            raise ValueError("tick_s must be > 0 (or None)")
         self.runtime = runtime
         self.pipeline_depth = pipeline_depth
         self.max_batch = max_batch
+        # periodic wake: with tick_s set, the pump also wakes every
+        # tick_s while IDLE and calls runtime.pump_tick() after every
+        # cycle — the adaptive-degradation controller's heartbeat
+        # (recovery must proceed on a quiet node, which an event-driven
+        # pump would never revisit)
+        self.tick_s = tick_s
         self._inbox: Deque[Tuple[str, tuple, float]] = deque()
         self._wake: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
@@ -151,9 +160,18 @@ class StepPump:
 
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
+        tick = getattr(self.runtime, "pump_tick", None)
         try:
             while not self._stopped:
-                await self._wake.wait()
+                if self.tick_s is None or self._wake.is_set():
+                    # busy path: no timer — a wait_for here would mint a
+                    # Task + TimerHandle per iteration, and that garbage
+                    # churn alone measurably fattens p99 under load
+                    await self._wake.wait()
+                else:
+                    handle = loop.call_later(self.tick_s, self._wake.set)
+                    await self._wake.wait()
+                    handle.cancel()
                 self._wake.clear()
                 while self._inbox and not self._stopped:
                     n = min(len(self._inbox), self.max_batch)
@@ -178,6 +196,12 @@ class StepPump:
                     )
                     self.iterations += 1
                     self.runtime.pump_flush(outcome)
+                if tick is not None:
+                    # after the drain (or an idle timeout): the
+                    # controller tick stays serialized with pump_process
+                    # iterations, so its lever mutations (batch size,
+                    # mempool ceilings) never race the proposer
+                    tick()
         except asyncio.CancelledError:
             raise
         except BaseException as exc:

@@ -43,7 +43,7 @@ import logging
 import random
 import zlib
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from hbbft_tpu.net import framing
 from hbbft_tpu.obs.metrics import Registry
@@ -231,19 +231,27 @@ class _DonorConn:
     """One client-role connection to a donor, used sequentially."""
 
     def __init__(self, addr: Addr, cluster_id: bytes, client_id: str,
-                 max_frame: int):
+                 max_frame: int, verify_node=None, challenge_rng=None):
         self.addr = addr
         self.cluster_id = cluster_id
         self.client_id = client_id
         self.max_frame = max_frame
+        self.verify_node = verify_node
+        self.challenge_rng = challenge_rng
         self.reader: Optional[asyncio.StreamReader] = None
         self.writer: Optional[asyncio.StreamWriter] = None
 
     async def connect(self, timeout_s: float) -> None:
+        # with verify_node set, the handshake CHALLENGEs the donor to
+        # sign with its era key — a snapshot source must prove it IS the
+        # validator its address claims (framing.client_hello_handshake);
+        # refusal surfaces as FrameError -> counted retry/failover
         self.reader, self.writer, _hello = \
             await framing.client_hello_handshake(
                 self.addr, self.cluster_id, self.client_id,
-                timeout_s=timeout_s, max_frame=self.max_frame)
+                timeout_s=timeout_s, max_frame=self.max_frame,
+                verify_node=self.verify_node,
+                challenge_rng=self.challenge_rng)
 
     async def request(self, msg: Any, timeout_s: float) -> Any:
         """Send one sync record, await the next SYNC reply (skipping
@@ -290,10 +298,17 @@ class StateSyncClient:
         seed: int = 0,
         max_frame: int = framing.DEFAULT_MAX_FRAME,
         registry: Optional[Registry] = None,
+        donor_key: Optional[Callable[[Any], Any]] = None,
     ):
         if not donors:
             raise ValueError("statesync needs at least one donor address")
         self.donors = list(donors)
+        # donor authentication: node_id -> plain public key (None =
+        # unknown donor).  With the callable set, every donor connection
+        # is challenge–response verified before any snapshot byte is
+        # trusted; without it the legacy identification-only handshake
+        # applies (the snapshot is still multi-donor cross-checked).
+        self.donor_key = donor_key
         self.cluster_id = bytes(cluster_id)
         self.client_id = client_id
         self.request_timeout_s = request_timeout_s
@@ -324,6 +339,40 @@ class StateSyncClient:
         self._c_abandoned = r.counter(
             "hbbft_sync_transfers_abandoned_total",
             "transfers abandoned after exhausting every donor cycle")
+        self._c_auth_fail = r.counter(
+            "hbbft_sync_donor_auth_failures_total",
+            "donor connections refused because the donor failed the "
+            "identity challenge (unknown id or bad era-key signature)")
+
+    def _verify_donor(self, node_id, era, sig_bytes, transcript) -> bool:
+        """client_hello_handshake verify_node hook: judge a donor's
+        challenge answer against the configured key map; every refusal
+        is counted before it surfaces as a connect failure."""
+        from hbbft_tpu.crypto import tc
+
+        ok = False
+        key = self.donor_key(node_id) if self.donor_key else None
+        if key is not None:
+            try:
+                ok = bool(key.verify(
+                    tc.Signature.from_bytes(bytes(sig_bytes)),
+                    transcript))
+            # hblint: disable=fault-swallowed-drop (accounted just
+            # below: every refusal path funnels into the shared
+            # hbbft_sync_donor_auth_failures_total increment)
+            except ValueError:
+                ok = False
+        if not ok:
+            self._c_auth_fail.inc()
+            logger.warning("statesync: donor claiming %r failed the "
+                           "identity challenge", node_id)
+        return ok
+
+    def _donor_conn(self, addr: Addr) -> _DonorConn:
+        return _DonorConn(
+            addr, self.cluster_id, self.client_id, self.max_frame,
+            verify_node=(self._verify_donor if self.donor_key else None),
+            challenge_rng=self.rng)
 
     # -- manifests -----------------------------------------------------------
 
@@ -334,8 +383,7 @@ class StateSyncClient:
         NACKs are skipped; each skip is a counted retry."""
 
         async def one(addr: Addr) -> Optional[SyncManifest]:
-            conn = _DonorConn(addr, self.cluster_id, self.client_id,
-                              self.max_frame)
+            conn = self._donor_conn(addr)
             try:
                 await conn.connect(self.connect_timeout_s)
                 reply = await conn.request(SyncManifestReq(),
@@ -431,8 +479,7 @@ class StateSyncClient:
         while len(chunks) < manifest.n_chunks:
             if conn is None:
                 addr = addrs[donor_i % len(addrs)]
-                conn = _DonorConn(addr, self.cluster_id, self.client_id,
-                                  self.max_frame)
+                conn = self._donor_conn(addr)
                 try:
                     await conn.connect(self.connect_timeout_s)
                 except (OSError, asyncio.TimeoutError,

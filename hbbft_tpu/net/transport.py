@@ -27,17 +27,30 @@ configured peer set, cluster-id mismatches, and version mismatches are
 rejected before any payload frame is parsed.  Client-role connections are
 handed to the runtime via ``on_client_frame``.
 
-SECURITY MODEL — the hello is identification, NOT authentication: node
-ids are self-declared and the cluster id derives from public config, so
-anyone who can reach a node's port can claim any validator identity and
-inject consensus messages attributed to it.  This mirrors the reference
-library's boundary (hbbft assumes authenticated point-to-point channels
-and leaves providing them to the embedder); run clusters only on trusted
-networks (localhost, a private fabric) or wrap the sockets in an
-authenticating layer (TLS/mTLS, WireGuard, or per-peer MACs keyed from
-``NetworkInfo``'s keypairs) before exposing a port.  The per-node Ed/BLS
-signatures INSIDE the protocol (DHB votes, key-gen messages, threshold
-shares) remain verified regardless.
+SECURITY MODEL — node-role hellos are AUTHENTICATED (protocol v3): a
+node hello is identification only until the acceptor's challenge is
+answered.  The acceptor issues a random nonce + session id; the dialer
+must sign the transcript (cluster id, nonce, session, claimed id, role,
+era — :func:`hbbft_tpu.net.framing.auth_transcript`) with the node's
+per-era secret key, and the acceptor verifies against the era key map
+(:class:`EraKeyRing`; the same ``NetworkInfo`` map the dynamic-peer
+resolver consults for WHERE, used here for WHO).  Until that signature
+verifies, the connection allocates NO per-peer guard state, every
+handshake frame is capped at ``framing.MAX_HANDSHAKE_FRAME`` bytes and
+timed out (the half-open handshake has its own byte/time budget plus a
+concurrent-connection cap, so the auth step cannot become the flood
+target), and refusals are counted (``hbbft_guard_auth_failures_total``)
+and journaled attributed to the attacker's SOCKET ENDPOINT — never to
+the impersonated validator.  The session id is bound into every
+subsequent heartbeat PING, so a hijacked TCP stream cannot ride an
+authenticated session.  A transport built without ``auth_verify`` (raw
+tests, sim harnesses) keeps the legacy identification-only behavior;
+``NodeRuntime`` always wires authentication when its protocol stack
+carries an era key map.  Residual gaps: client-role and obs ports stay
+identification-only — bind them to localhost or a private fabric — and
+transport auth is a floor under the per-node Ed/BLS signatures INSIDE
+the protocol (DHB votes, key-gen messages, threshold shares), which
+remain verified regardless.
 
 All callbacks run on the event loop; they may call :meth:`Transport.send`
 re-entrantly (it only enqueues).
@@ -159,16 +172,32 @@ class IngressBudget:
       peer's node-role hellos are rejected until the (exponentially
       growing, capped) backoff expires.
 
-    Budgets attribute to the CLAIMED peer identity — the hello is
-    identification, not authentication (see the module security model),
-    so an attacker claiming validator X's identity spends X's budget.
-    On a trusted fabric that is the right ledger; anywhere else, wrap
-    the sockets in an authenticating layer first.
+    Budgets attribute to the VERIFIED peer identity: with transport
+    authentication on (see the module security model) no per-peer state
+    is allocated — and none of the meters above are chargeable — until
+    the dialer proves the claimed identity with its era key, so a spoofer
+    cannot spend validator X's budget or burn X's strike ladder.  Failed
+    proofs are counted per refusal *reason* (``auth_failures`` below) and
+    attributed to the attacker's socket endpoint.  On a transport built
+    without ``auth_verify`` the ledger reverts to claimed identities;
+    run that mode only on a trusted fabric.
 
     Defaults are sized far above honest consensus traffic (a 4-node
     pipelined cluster peaks well under 1 MiB/s per peer) so the guard
     only ever engages on floods.
     """
+
+    #: every way a handshake can be refused — each refusal is counted
+    #: under exactly one of these (pre-initialized so a zero shows up in
+    #: scrapes before the first attack): signature did not verify
+    #: (``bad_sig``), claimed id absent from every admissible era map
+    #: (``unknown_key``), a non-AUTH frame where the proof was due
+    #: (``no_auth``), an unparsable handshake frame (``malformed``), the
+    #: proof never arrived in time (``timeout``), a heartbeat carrying
+    #: the wrong session id on an authenticated stream (``session``),
+    #: or the half-open connection cap was hit (``half_open``).
+    AUTH_FAIL_REASONS = ("bad_sig", "unknown_key", "no_auth", "malformed",
+                         "timeout", "session", "half_open")
 
     def __init__(self, registry=None, *,
                  bytes_per_s: float = 16 * 2**20,
@@ -229,6 +258,25 @@ class IngressBudget:
             "hbbft_guard_inflight_frames",
             "frames admitted from a peer but not yet processed by the "
             "pump", labelnames=("peer",), max_label_sets=33)
+        self._c_auth_ok = r.counter(
+            "hbbft_guard_auth_ok_total",
+            "node-role handshakes that proved the claimed identity with "
+            "a valid era-key signature")
+        self._c_auth_stale = r.counter(
+            "hbbft_guard_auth_stale_era_total",
+            "handshakes accepted against the PREVIOUS era's key map "
+            "within the rotation grace window (counted, not refused)")
+        # reason cardinality is fixed by AUTH_FAIL_REASONS; the attacker
+        # endpoint is deliberately NOT a label (unbounded cardinality) —
+        # it travels through the guard-event journal instead
+        self._c_auth_fail = r.counter(
+            "hbbft_guard_auth_failures_total",
+            "node-role handshakes refused before allocating any "
+            "per-peer state, by refusal reason",
+            labelnames=("reason",),
+            max_label_sets=len(self.AUTH_FAIL_REASONS) + 1)
+        for reason in self.AUTH_FAIL_REASONS:
+            self._c_auth_fail.labels(reason=reason)
         r.register_callback(self._refresh_gauges)
 
     def _refresh_gauges(self) -> None:
@@ -368,6 +416,32 @@ class IngressBudget:
         with self._lock:
             self._budget(peer).inflight += n
 
+    # -- handshake authentication surface (event loop) -----------------------
+
+    def auth_ok(self) -> None:
+        self._c_auth_ok.inc()
+
+    def auth_stale(self, peer: NodeId) -> None:
+        """A handshake that verified against the PREVIOUS era's key
+        inside the rotation grace window: admitted, but counted — a
+        burst of these outside a rotation is worth an operator's look."""
+        self._c_auth_stale.inc()
+        logger.info("guard: peer %r authenticated with previous-era key "
+                    "(rotation grace window)", peer)
+
+    def auth_fail(self, endpoint: str, claimed: Any, reason: str) -> None:
+        """A refused handshake: counted by ``reason`` and journaled
+        attributed to the attacker's socket ENDPOINT — never to the
+        impersonated ``claimed`` identity, whose budgets and strike
+        ladder stay untouched (no per-peer state exists yet)."""
+        if reason not in self.AUTH_FAIL_REASONS:
+            reason = "malformed"
+        self._c_auth_fail.labels(reason=reason).inc()
+        self._emit("auth_fail", endpoint,
+                   f"claimed={claimed!r} reason={reason}")
+        logger.warning("guard: refused handshake from %s claiming %r "
+                       "(%s)", endpoint, claimed, reason)
+
     # -- consumer surface (pump worker thread) -------------------------------
 
     def frame_done(self, peer: NodeId, n: int = 1) -> None:
@@ -410,8 +484,72 @@ class IngressBudget:
             "disconnects": int(self._c_disconnects.total()),
             "hello_rejects": int(self._c_hello_rejects.total()),
             "decode_strikes": int(self._c_decode_strikes.total()),
+            "auth_ok": int(self._c_auth_ok.total()),
+            "auth_stale_era": int(self._c_auth_stale.total()),
+            "auth_failures": {
+                reason: int(self._c_auth_fail.value(reason=reason))
+                for reason in self.AUTH_FAIL_REASONS
+            },
             "peers": self.peer_doc(),
         }
+
+
+class EraKeyRing:
+    """Per-era public-key lookup for handshake verification, with a
+    bounded previous-era grace window.
+
+    ``provider()`` returns ``(era, {node_id: public_key})`` — the
+    CURRENT era's key map (``NodeRuntime`` reads it off the live
+    protocol's ``NetworkInfo``).  The ring polls the provider on every
+    lookup; when the era advances it stashes the outgoing map so that a
+    peer still dialing with the *previous* era's key during an in-flight
+    DKG rotation verifies within ``grace_s`` seconds (counted
+    ``hbbft_guard_auth_stale_era_total`` by the caller) instead of being
+    refused into a strike-laddered retry storm.  Exactly one previous
+    era is retained and it expires on the clock, so the admissible key
+    set stays bounded.  The converse race — a dialer already rotated
+    ahead of an acceptor that has not observed the new era yet — needs
+    no stash: the plain keypairs rarely change across eras (re-adds keep
+    keys), and a genuinely new key is refused ``unknown_key`` until the
+    acceptor's own rotation lands, bounded by the dialer's backoff.
+    """
+
+    def __init__(self, provider: Callable[[], Tuple[int, Dict[NodeId, Any]]],
+                 *, grace_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.provider = provider
+        self.grace_s = float(grace_s)
+        self.clock = clock
+        self._era: Optional[int] = None
+        self._keys: Dict[NodeId, Any] = {}
+        self._prev_era: Optional[int] = None
+        self._prev_keys: Dict[NodeId, Any] = {}
+        self._prev_at = 0.0
+
+    def _refresh(self) -> None:
+        era, keys = self.provider()
+        if self._era is not None and era != self._era:
+            self._prev_era = self._era
+            self._prev_keys = self._keys
+            self._prev_at = self.clock()
+        self._era = era
+        self._keys = dict(keys)
+
+    def lookup(self, node_id: NodeId) -> List[Tuple[int, Any, bool]]:
+        """Admissible ``(era, public_key, stale)`` candidates for a
+        claimed id, current era first.  Empty when the id is unknown to
+        every admissible era."""
+        self._refresh()
+        out: List[Tuple[int, Any, bool]] = []
+        key = self._keys.get(node_id)
+        if key is not None:
+            out.append((self._era, key, False))
+        if (self._prev_era is not None
+                and self.clock() - self._prev_at <= self.grace_s):
+            prev = self._prev_keys.get(node_id)
+            if prev is not None:
+                out.append((self._prev_era, prev, True))
+        return out
 
 
 class _LabeledCounterView:
@@ -646,6 +784,10 @@ class _PeerSender:
             f"{transport.our_id!r}->{peer_id!r}"
         )
         self.task: Optional[asyncio.Task] = None
+        # session id issued by the acceptor's CHALLENGE (None on a
+        # legacy unauthenticated handshake); bound into every heartbeat
+        # PING so a hijacked stream can't ride the authenticated session
+        self.session: Optional[bytes] = None
 
     def start(self) -> None:
         self.task = asyncio.get_running_loop().create_task(
@@ -743,6 +885,30 @@ class _PeerSender:
             framing.read_one_frame(reader, self.t.max_frame),
             self.t.dead_after_s,
         )
+        self.session = None
+        if kind == framing.CHALLENGE:
+            # authenticated acceptor: prove our identity by signing the
+            # challenge transcript with our current era key, then the
+            # hello reply follows on success
+            if self.t.auth_sign is None:
+                raise FrameError(
+                    "peer demands an authenticated handshake but this "
+                    "transport has no signer (auth disabled?)"
+                )
+            nonce, session = framing.decode_challenge(payload)
+            era, sig = self.t.auth_sign(self.t.cluster_id, nonce, session)
+            auth = framing.encode_frame(
+                framing.AUTH, framing.encode_auth(era, sig),
+                self.t.max_frame,
+            )
+            writer.write(auth)
+            await writer.drain()
+            self.t._record_send(self.peer_id, auth)
+            kind, payload = await asyncio.wait_for(
+                framing.read_one_frame(reader, self.t.max_frame),
+                self.t.dead_after_s,
+            )
+            self.session = session
         if kind != framing.HELLO:
             raise FrameError(f"expected HELLO reply, got kind {kind}")
         hello = framing.decode_hello(payload)
@@ -819,8 +985,12 @@ class _PeerSender:
                         await asyncio.sleep(0)
 
         async def ping_once():
+            # on an authenticated session the PING carries the session
+            # id issued at the handshake — the acceptor refuses the
+            # stream if it ever mismatches (hijack defense)
+            prefix = self.session if self.session is not None else b""
             frame = framing.encode_frame(
-                framing.PING, struct.pack(">Q", ping_nonce),
+                framing.PING, prefix + struct.pack(">Q", ping_nonce),
                 self.t.max_frame,
             )
             async with wlock:
@@ -921,6 +1091,13 @@ class Transport:
         ] = None,
         ingress: Optional[IngressBudget] = None,
         ingress_kwargs: Optional[Dict[str, Any]] = None,
+        auth_sign: Optional[
+            Callable[[bytes, bytes, bytes], Tuple[int, bytes]]
+        ] = None,
+        auth_verify: Optional[
+            Callable[[NodeId, int, int, bytes, bytes, bytes], str]
+        ] = None,
+        max_half_open: int = 64,
     ):
         self.our_id = our_id
         self.cluster_id = bytes(cluster_id)
@@ -990,6 +1167,25 @@ class Transport:
         self._inbound_tasks: set = set()
         self._stopping = False
         self.addr: Optional[Addr] = None
+        # handshake authentication (module security model).  auth_sign
+        # answers an acceptor's CHALLENGE with (era, signature) over the
+        # transcript; auth_verify judges an inbound proof -> verdict in
+        # {"ok", "stale", "bad_sig", "unknown_key"}.  Both are embedder
+        # callbacks so the transport stays crypto-free; None keeps the
+        # legacy identification-only handshake on that side.
+        self.auth_sign = auth_sign
+        self.auth_verify = auth_verify
+        # half-open budget: connections past accept() but not yet past
+        # the handshake.  The cap (with the per-frame MAX_HANDSHAKE_FRAME
+        # byte cap and dead_after_s time cap) bounds what a SYN-and-stall
+        # flood can pin, so the auth step can't become the flood target.
+        self.max_half_open = int(max_half_open)
+        self._half_open = 0
+        # challenge nonces/session ids: seeded for deterministic tests
+        self._auth_rng = random.Random(
+            int.from_bytes(hashlib.sha3_256(
+                b"hbbft-net-auth:%d:%s" % (seed, repr(our_id).encode())
+            ).digest()[:8], "big"))
 
     def chaos_now(self) -> float:
         """The link-shaping clock (seconds since transport creation)."""
@@ -1095,17 +1291,67 @@ class Transport:
             self._inbound_tasks.discard(task)
             writer.close()
 
+    @staticmethod
+    def _endpoint(writer: asyncio.StreamWriter) -> str:
+        """The remote socket address as ``host:port`` — the attribution
+        handle for refused handshakes (a spoofer's CLAIMED id must never
+        be the ledger key)."""
+        peer = writer.get_extra_info("peername")
+        try:
+            return f"{peer[0]}:{peer[1]}"
+        # hblint: disable=fault-swallowed-drop (address formatting
+        # fallback, no input dropped — the refusal this string labels
+        # is itself counted at every call site)
+        except (TypeError, IndexError):
+            return "<unknown>"
+
+    def _rand_bytes(self, n: int) -> bytes:
+        return self._auth_rng.getrandbits(8 * n).to_bytes(n, "big")
+
     async def _serve_inbound(self, reader: asyncio.StreamReader,
                              writer: asyncio.StreamWriter) -> None:
+        # half-open budget: the handshake phase is the only window where
+        # an unproven endpoint holds a task/fd, so it is capped (count +
+        # refuse past the cap), byte-capped (MAX_HANDSHAKE_FRAME per
+        # frame), and time-capped (dead_after_s per read)
+        self._half_open += 1
+        try:
+            if self._half_open > self.max_half_open:
+                self.ingress.auth_fail(self._endpoint(writer), None,
+                                       "half_open")
+                raise FrameError("half-open handshake budget exhausted")
+            hello, session = await self._inbound_handshake(reader, writer)
+        finally:
+            self._half_open -= 1
+        if hello.role == ROLE_NODE:
+            self._notify_hello(hello.node_id, hello, direction="accept")
+            await self._node_recv_loop(hello.node_id, reader, writer,
+                                       session)
+        else:
+            await self._client_recv_loop(hello, reader, writer)
+
+    async def _inbound_handshake(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+    ) -> Tuple[Hello, Optional[bytes]]:
+        """Read + judge one inbound hello; returns the hello and the
+        issued session id (None on the legacy unauthenticated path).
+        ORDER MATTERS: a node-role claim is challenged and VERIFIED
+        before ``in_backoff``/``connection_accepted``/peer resolution
+        run — a spoofer must not clear the impersonated victim's strike
+        ladder, consume its backoff gate, or allocate any per-peer state."""
+        hs_frame = min(self.max_frame, framing.MAX_HANDSHAKE_FRAME)
         kind, payload = await asyncio.wait_for(
-            framing.read_one_frame(reader, self.max_frame), self.dead_after_s
+            framing.read_one_frame(reader, hs_frame), self.dead_after_s
         )
         if kind != framing.HELLO:
             raise FrameError(f"first frame must be HELLO, got kind {kind}")
         hello = framing.decode_hello(payload)
         if hello.cluster_id != self.cluster_id:
             raise FrameError("cluster id mismatch")
+        session: Optional[bytes] = None
         if hello.role == ROLE_NODE:
+            if self.auth_verify is not None:
+                session = await self._challenge(reader, writer, hello)
             if self.ingress.in_backoff(hello.node_id):
                 # the counted disconnect's backoff window: a flooding
                 # peer redialing immediately is refused until it expires
@@ -1131,11 +1377,63 @@ class Transport:
         writer.write(reply)
         await writer.drain()
         self._record_send(hello.node_id, reply)
-        if hello.role == ROLE_NODE:
-            self._notify_hello(hello.node_id, hello, direction="accept")
-            await self._node_recv_loop(hello.node_id, reader, writer)
+        return hello, session
+
+    async def _challenge(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter,
+                         hello: Hello) -> bytes:
+        """Issue CHALLENGE, await AUTH, verify — every refusal path is
+        counted under exactly one ``hbbft_guard_auth_failures_total``
+        reason and attributed to the socket endpoint."""
+        endpoint = self._endpoint(writer)
+        claimed = hello.node_id
+        nonce = self._rand_bytes(framing.NONCE_LEN)
+        session = self._rand_bytes(framing.SESSION_LEN)
+        challenge = framing.encode_frame(
+            framing.CHALLENGE, framing.encode_challenge(nonce, session),
+            self.max_frame,
+        )
+        writer.write(challenge)
+        await writer.drain()
+        self._record_send(claimed, challenge)
+        try:
+            kind, payload = await asyncio.wait_for(
+                framing.read_one_frame(reader, framing.MAX_HANDSHAKE_FRAME),
+                self.dead_after_s,
+            )
+        except asyncio.TimeoutError:
+            self.ingress.auth_fail(endpoint, claimed, "timeout")
+            raise
+        except (FrameError, asyncio.IncompleteReadError):
+            self.ingress.auth_fail(endpoint, claimed, "malformed")
+            raise
+        if kind != framing.AUTH:
+            self.ingress.auth_fail(endpoint, claimed, "no_auth")
+            raise FrameError(
+                f"expected AUTH from {endpoint} claiming {claimed!r}, "
+                f"got kind {kind}"
+            )
+        self._record_recv(claimed, kind, payload)
+        try:
+            era, sig = framing.decode_auth(payload)
+        except FrameError:
+            self.ingress.auth_fail(endpoint, claimed, "malformed")
+            raise
+        verdict = self.auth_verify(claimed, hello.role, era, sig,
+                                   nonce, session)
+        if verdict == "ok":
+            self.ingress.auth_ok()
+        elif verdict == "stale":
+            self.ingress.auth_stale(claimed)
         else:
-            await self._client_recv_loop(hello, reader, writer)
+            reason = (verdict if verdict in ("bad_sig", "unknown_key")
+                      else "bad_sig")
+            self.ingress.auth_fail(endpoint, claimed, reason)
+            raise FrameError(
+                f"handshake auth failed for {endpoint} claiming "
+                f"{claimed!r}: {verdict}"
+            )
+        return session
 
     async def _idle_watchdog(self, writer: asyncio.StreamWriter,
                              state: list, idle_timeout: float) -> None:
@@ -1159,7 +1457,8 @@ class Transport:
 
     async def _node_recv_loop(self, peer_id: NodeId,
                               reader: asyncio.StreamReader,
-                              writer: asyncio.StreamWriter) -> None:
+                              writer: asyncio.StreamWriter,
+                              session: Optional[bytes] = None) -> None:
         decoder = FrameDecoder(self.max_frame)
         # a live dialer pings every heartbeat_s, so silence beyond the
         # dead-peer window means a half-open socket (peer power-loss,
@@ -1172,14 +1471,15 @@ class Transport:
         )
         try:
             await self._node_recv_inner(peer_id, reader, writer,
-                                        decoder, state)
+                                        decoder, state, session)
         finally:
             watchdog.cancel()
 
     async def _node_recv_inner(self, peer_id: NodeId,
                                reader: asyncio.StreamReader,
                                writer: asyncio.StreamWriter,
-                               decoder: FrameDecoder, state: list) -> None:
+                               decoder: FrameDecoder, state: list,
+                               session: Optional[bytes] = None) -> None:
         timing = getattr(self, "timing", None)
         # always-on recv segment observer (the runtime wires the
         # hbbft_pump_segment_seconds "recv" child here); one observe per
@@ -1195,11 +1495,13 @@ class Transport:
                 return
             state[0] = time.monotonic()
             if timing is None and seg_recv is None:
-                self._recv_chunk(peer_id, writer, decoder, data)
+                self._recv_chunk(peer_id, writer, decoder, data,
+                                 session=session)
             else:
                 w0 = time.perf_counter()
                 t0 = time.thread_time() if timing is not None else 0.0
-                self._recv_chunk(peer_id, writer, decoder, data)
+                self._recv_chunk(peer_id, writer, decoder, data,
+                                 session=session)
                 if timing is not None:
                     timing["recv"] = (
                         timing.get("recv", 0.0)
@@ -1235,7 +1537,8 @@ class Transport:
                 state[0] = time.monotonic()
 
     def _recv_chunk(self, peer_id: NodeId, writer: asyncio.StreamWriter,
-                    decoder: FrameDecoder, data: bytes) -> None:
+                    decoder: FrameDecoder, data: bytes, *,
+                    session: Optional[bytes] = None) -> None:
         """One chunk of the node recv path — synchronous on purpose: the
         PONG reply is written without an awaited drain (a 15-byte reply
         to a rare heartbeat; asyncio flushes it on the next loop pass),
@@ -1243,6 +1546,18 @@ class Transport:
         for kind, payload in decoder.feed(data):
             self._record_recv(peer_id, kind, payload)
             if kind == framing.PING:
+                if session is not None and (
+                        len(payload) != framing.SESSION_LEN + 8
+                        or payload[:framing.SESSION_LEN] != session):
+                    # an authenticated stream's heartbeat must carry the
+                    # session id issued at the handshake: a mismatch is
+                    # a hijacked/confused stream — refuse it loudly
+                    self.ingress.auth_fail(self._endpoint(writer),
+                                           peer_id, "session")
+                    raise FrameError(
+                        f"heartbeat with wrong session id on "
+                        f"authenticated stream from {peer_id!r}"
+                    )
                 pong = framing.encode_frame(
                     framing.PONG, payload, self.max_frame
                 )
@@ -1289,6 +1604,21 @@ class Transport:
                     self._record_recv(hello.node_id, kind, payload)
                     if kind == framing.PING:
                         conn.send(framing.PONG, payload)
+                    elif kind == framing.CHALLENGE:
+                        # a state-sync fetcher verifying this DONOR: sign
+                        # its challenge with our current era key (clients
+                        # stay identification-only; this authenticates
+                        # the NODE side of the client connection)
+                        if self.auth_sign is None:
+                            raise FrameError(
+                                "client challenged this node but it has "
+                                "no signer (auth disabled?)"
+                            )
+                        nonce, csession = framing.decode_challenge(payload)
+                        era, sig = self.auth_sign(self.cluster_id,
+                                                  nonce, csession)
+                        conn.send(framing.AUTH,
+                                  framing.encode_auth(era, sig))
                     elif self.on_client_frame is not None:
                         self.on_client_frame(conn, kind, payload)
         finally:

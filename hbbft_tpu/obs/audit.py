@@ -69,14 +69,21 @@ _OVERLOAD_FAULT_KINDS = frozenset({
 
 
 def _parse_guard_note(detail: str) -> Optional[Dict[str, str]]:
-    """``kind=K peer=P …`` → {kind, peer} (the runtime's overload-guard
-    journal format; see NodeRuntime._process_guard_event)."""
+    """``kind=K peer=P …`` → {kind, peer[, claimed]} (the runtime's
+    overload-guard journal format; see NodeRuntime._process_guard_event).
+    ``auth_fail`` notes carry both sides of a spoof: ``peer`` is the
+    ATTACKER's socket endpoint, ``claimed`` the impersonated identity —
+    keeping them separate is what lets the incident report blame the
+    endpoint without smearing the victim."""
     fields = dict(
         part.split("=", 1) for part in detail.split() if "=" in part
     )
     if "kind" not in fields or "peer" not in fields:
         return None
-    return {"kind": fields["kind"], "peer": fields["peer"]}
+    out = {"kind": fields["kind"], "peer": fields["peer"]}
+    if "claimed" in fields:
+        out["claimed"] = fields["claimed"]
+    return out
 
 
 def _parse_statesync_note(detail: str) -> Optional[Dict[str, Any]]:
@@ -297,11 +304,14 @@ def audit(journals: List[Journal]) -> AuditResult:
     # overload[peer] = {"kinds": {kind: count}, "witnesses": set}
     overload: Dict[str, Dict[str, Any]] = {}
 
-    def _overload_hit(peer: str, kind: str, witness: str) -> None:
+    def _overload_hit(peer: str, kind: str, witness: str,
+                      claimed: Optional[str] = None) -> None:
         entry = overload.setdefault(
-            peer, {"kinds": {}, "witnesses": set()})
+            peer, {"kinds": {}, "witnesses": set(), "claimed": set()})
         entry["kinds"][kind] = entry["kinds"].get(kind, 0) + 1
         entry["witnesses"].add(witness)
+        if claimed is not None:
+            entry["claimed"].add(claimed)
 
     for j in journals:
         node = j.node
@@ -414,7 +424,8 @@ def audit(journals: List[Journal]) -> AuditResult:
                 elif rec.kind == "guard":
                     hit = _parse_guard_note(rec.detail)
                     if hit is not None:
-                        _overload_hit(hit["peer"], hit["kind"], node)
+                        _overload_hit(hit["peer"], hit["kind"], node,
+                                      hit.get("claimed"))
     res.events.sort(key=lambda e: (e.era, e.epoch, e.rank, e.key))
     # resource-exhaustion attribution: most-implicated peer first
     res.overload_incidents = [
@@ -423,6 +434,11 @@ def audit(journals: List[Journal]) -> AuditResult:
             "kinds": dict(sorted(entry["kinds"].items())),
             "witnesses": sorted(entry["witnesses"]),
             "events": sum(entry["kinds"].values()),
+            # spoof attribution: the identities this endpoint CLAIMED
+            # while failing authentication (distinct from "peer" — the
+            # impersonated validator is the victim, not the attacker)
+            **({"claimed_identities": sorted(entry["claimed"])}
+               if entry["claimed"] else {}),
         }
         for peer, entry in sorted(
             overload.items(),

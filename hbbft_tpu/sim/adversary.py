@@ -495,6 +495,26 @@ class FloodAdversary(Adversary):
             self.injected += 1
 
 
+class SpoofReplayAdversary(FloodAdversary):
+    """Replay-as-spoof: the in-sim analog of identity spoofing.
+
+    Under the authenticated transport an attacker cannot FORGE a
+    validator's messages — the strongest impersonation left is
+    replaying byte-identical copies of messages the victim genuinely
+    sent.  Every crank this adversary re-injects seeded duplicates of
+    the victim's in-flight traffic (and amplifies fresh emissions
+    ``copies``-fold), exactly :class:`FloodAdversary`'s mechanics but
+    with an HONEST victim: the protocols treat duplicates as no-ops,
+    every node keeps committing, and the cell verdict must stay CLEAN —
+    the replayed victim did nothing wrong and must never be blamed for
+    traffic it sent once (``spec.faulty`` excludes it)."""
+
+    def __init__(self, victim, seed: int = 0, copies: int = 2,
+                 budget: Optional[int] = None):
+        super().__init__(victim, seed=seed, copies=copies, budget=budget)
+        self.victim = victim
+
+
 class FutureEpochSpamAdversary(Adversary):
     """Window-edge protocol spam: the spammer injects binary-agreement
     messages addressed to epochs at ``hb.epoch + max_future_epochs`` —
@@ -584,11 +604,19 @@ class GarbageStreamAdversary:
     """
 
     def __init__(self, seed: int = 0, budget_frames: int = 20_000,
-                 frame_bytes: int = 256, valid_frames: bool = False):
+                 frame_bytes: int = 256, valid_frames: bool = False,
+                 secret_key=None):
         self.rng = random.Random(seed)
         self.budget_frames = budget_frames
         self.frame_bytes = frame_bytes
         self.valid_frames = valid_frames
+        # the claimed identity's plain BLS secret key: with it, the
+        # drill models a COMPROMISED validator — the handshake
+        # challenge is answered correctly and the flood proceeds past
+        # an authenticating victim; without it, an auth-enabled victim
+        # refuses the hello outright (that refusal is
+        # IdentitySpoofAdversary's drill, not this one's)
+        self.secret_key = secret_key
         self.frames_sent = 0
         self.bytes_sent = 0
         # connection teardowns observed, INCLUDING hellos refused
@@ -621,7 +649,7 @@ class GarbageStreamAdversary:
             framing.DEFAULT_MAX_FRAME)
 
     async def run(self, addr, cluster_id: bytes, identity,
-                  duration_s: float = 10.0) -> None:
+                  duration_s: float = 10.0, era: int = 0) -> None:
         """Flood ``addr`` claiming ``identity`` until the frame budget
         or ``duration_s`` runs out, reconnecting through disconnects."""
         import asyncio
@@ -641,14 +669,34 @@ class GarbageStreamAdversary:
             try:
                 hello = framing.Hello(
                     node_id=identity, role=framing.ROLE_NODE,
-                    cluster_id=bytes(cluster_id), era=0, epoch=0)
+                    cluster_id=bytes(cluster_id), era=era, epoch=0)
                 writer.write(framing.encode_frame(
                     framing.HELLO, framing.encode_hello(hello),
                     framing.DEFAULT_MAX_FRAME))
                 await writer.drain()
-                kind, _payload = await asyncio.wait_for(
+                kind, payload = await asyncio.wait_for(
                     framing.read_one_frame(
                         reader, framing.DEFAULT_MAX_FRAME), 2.0)
+                if kind == framing.CHALLENGE:
+                    # authenticated victim: with the compromised key the
+                    # challenge is answered properly (the flood drill
+                    # continues past the handshake); without it this
+                    # connection is already lost — surface the refusal
+                    if self.secret_key is None:
+                        raise ConnectionError(
+                            "victim demands auth and no key was given")
+                    nonce, session = framing.decode_challenge(payload)
+                    transcript = framing.auth_transcript(
+                        bytes(cluster_id), nonce, session, identity,
+                        framing.ROLE_NODE, era)
+                    sig = self.secret_key.sign(transcript).to_bytes()
+                    writer.write(framing.encode_frame(
+                        framing.AUTH, framing.encode_auth(era, sig),
+                        framing.DEFAULT_MAX_FRAME))
+                    await writer.drain()
+                    kind, payload = await asyncio.wait_for(
+                        framing.read_one_frame(
+                            reader, framing.DEFAULT_MAX_FRAME), 2.0)
                 if kind != framing.HELLO:
                     raise ConnectionError(
                         f"unexpected reply kind {kind}")
@@ -669,6 +717,140 @@ class GarbageStreamAdversary:
                 # the observable — count it and press on
                 self.disconnects += 1
                 await asyncio.sleep(0.1)
+            finally:
+                writer.close()
+
+
+class IdentitySpoofAdversary:
+    """Raw-socket identity theft against an AUTHENTICATED node.
+
+    Dials a live node's port claiming a CORRECT validator identity in
+    the hello, then fails the challenge–response in one of four ways:
+
+    - ``nokey``: answers the CHALLENGE with seeded random bytes where
+      the era-key signature belongs (an attacker holding no key
+      material at all);
+    - ``wrongkey``: signs the exact transcript with a DIFFERENT secret
+      key (compromised non-validator key trying to impersonate);
+    - ``hijack``: skips AUTH entirely and streams a protocol MSG frame
+      in its place (inject-before-the-challenge-completes, the
+      session-hijack shape);
+    - ``downgrade``: signs with the wrong key while claiming an
+      ancient era (an era-downgrade probe at the rotation grace
+      window).
+
+    The victim must refuse every attempt BEFORE allocating any
+    per-peer state: zero spoofed frames reach the protocol, the
+    impersonated validator's budgets/strikes stay untouched, and every
+    refusal is counted (``hbbft_guard_auth_failures_total``) and
+    journaled with the ATTACKER's endpoint — never the victim's.  From
+    outside, a refusal is the stream closing without a hello reply;
+    ``hellos_accepted`` staying 0 is the spoof-proof acceptance
+    criterion this driver can observe directly.
+    """
+
+    MODES = ("nokey", "wrongkey", "hijack", "downgrade")
+
+    def __init__(self, seed: int = 0, mode: str = "nokey",
+                 secret_key=None, claim_era: int = 0,
+                 budget_attempts: int = 40):
+        if mode not in self.MODES:
+            raise ValueError(f"unknown spoof mode {mode!r}")
+        if mode in ("wrongkey", "downgrade") and secret_key is None:
+            raise ValueError(f"mode {mode!r} needs a (wrong) secret_key")
+        self.rng = random.Random(seed)
+        self.mode = mode
+        self.secret_key = secret_key
+        self.claim_era = claim_era
+        self.budget_attempts = budget_attempts
+        self.attempts = 0
+        #: refusals observed (stream closed / no hello reply) — the
+        #: defense engaging, seen from the attacker's side
+        self.refusals = 0
+        #: spoofed hellos the victim ACCEPTED — must stay 0
+        self.hellos_accepted = 0
+
+    def _auth_payload(self, cluster_id: bytes, nonce: bytes,
+                      session: bytes, identity) -> bytes:
+        from hbbft_tpu.net import framing
+
+        era = self.claim_era
+        if self.secret_key is not None:
+            transcript = framing.auth_transcript(
+                bytes(cluster_id), nonce, session, identity,
+                framing.ROLE_NODE, era)
+            sig = self.secret_key.sign(transcript).to_bytes()
+        else:
+            sig = bytes(self.rng.randrange(256) for _ in range(96))
+        return framing.encode_auth(era, sig)
+
+    async def run(self, addr, cluster_id: bytes, identity,
+                  duration_s: float = 5.0) -> None:
+        """Spoof ``identity`` at ``addr`` until the attempt budget or
+        ``duration_s`` runs out; every refusal feeds the next try."""
+        import asyncio
+        import time as _time
+
+        from hbbft_tpu.net import framing
+
+        deadline = _time.monotonic() + duration_s
+        while (self.attempts < self.budget_attempts
+               and _time.monotonic() < deadline):
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(*addr), 2.0)
+            except (OSError, asyncio.TimeoutError):
+                await asyncio.sleep(0.05)
+                continue
+            self.attempts += 1
+            try:
+                hello = framing.Hello(
+                    node_id=identity, role=framing.ROLE_NODE,
+                    cluster_id=bytes(cluster_id), era=self.claim_era,
+                    epoch=0)
+                writer.write(framing.encode_frame(
+                    framing.HELLO, framing.encode_hello(hello),
+                    framing.DEFAULT_MAX_FRAME))
+                await writer.drain()
+                kind, payload = await asyncio.wait_for(
+                    framing.read_one_frame(
+                        reader, framing.DEFAULT_MAX_FRAME), 2.0)
+                if kind == framing.HELLO:
+                    # unauthenticated victim took the spoof at face
+                    # value — the exact hole this drill exists to catch
+                    self.hellos_accepted += 1
+                    continue
+                if kind != framing.CHALLENGE:
+                    raise ConnectionError(
+                        f"unexpected reply kind {kind}")
+                nonce, session = framing.decode_challenge(payload)
+                if self.mode == "hijack":
+                    # stream a protocol frame where AUTH belongs: the
+                    # victim must refuse it unparsed (no_auth), not
+                    # feed it to the protocol
+                    writer.write(framing.encode_frame(
+                        framing.MSG,
+                        bytes(self.rng.randrange(256)
+                              for _ in range(64)),
+                        framing.DEFAULT_MAX_FRAME))
+                else:
+                    writer.write(framing.encode_frame(
+                        framing.AUTH,
+                        self._auth_payload(cluster_id, nonce, session,
+                                           identity),
+                        framing.DEFAULT_MAX_FRAME))
+                await writer.drain()
+                kind, _ = await asyncio.wait_for(
+                    framing.read_one_frame(
+                        reader, framing.DEFAULT_MAX_FRAME), 2.0)
+                if kind == framing.HELLO:
+                    self.hellos_accepted += 1
+            except (OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError, ConnectionError,
+                    framing.FrameError):
+                # refused before the hello reply: the defense held
+                self.refusals += 1
+                await asyncio.sleep(0.02)
             finally:
                 writer.close()
 

@@ -1,0 +1,249 @@
+"""Guard-driven adaptive degradation (`net/degrade.py`).
+
+The controller contract: pressure read as counter deltas per window,
+one ladder level per window up under sustained pressure, hysteresis in
+the middle band, ``clear_windows`` consecutive quiet windows per level
+down, and every lever a pure function of (level, attach-time base) so
+recovery restores the EXACT configured values.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from hbbft_tpu.net.degrade import DegradationController, attach_runtime
+from hbbft_tpu.obs.metrics import Registry
+
+
+def _controller(**kwargs):
+    clock = [0.0]
+    count = [0.0]
+    applied = []
+    defaults = dict(
+        sources=[("src", lambda: count[0])],
+        apply_level=applied.append,
+        registry=Registry(),
+        window_s=1.0,
+        engage_per_s=5.0,
+        clear_per_s=1.0,
+        clear_windows=2,
+        max_level=2,
+        clock=lambda: clock[0],
+    )
+    defaults.update(kwargs)
+    return DegradationController(**defaults), clock, count, applied
+
+
+def test_shrink_halves_per_level_with_floor():
+    shrink = DegradationController.shrink
+    assert shrink(64, 0, 8) == 64
+    assert shrink(64, 1, 8) == 32
+    assert shrink(64, 3, 8) == 8
+    assert shrink(64, 6, 8) == 8    # floored, never 1 or 0
+    assert shrink(6, 1, 8) == 8     # base below floor: floor wins
+
+
+def test_ladder_engages_holds_and_clears_with_hysteresis():
+    ctl, clock, count, applied = _controller()
+
+    # sub-window tick is a no-op
+    clock[0] = 0.5
+    count[0] = 100.0
+    ctl.tick()
+    assert ctl.level == 0 and applied == []
+
+    # sustained pressure: one level per window, capped at max_level
+    for i, t in enumerate((1.0, 2.0, 3.0)):
+        clock[0] = t
+        count[0] += 10.0
+        ctl.tick()
+    assert ctl.level == 2 and applied == [1, 2]  # third window capped
+
+    # middle band: hold the level AND reset the clean-window count
+    clock[0] = 4.0
+    count[0] += 3.0  # 3/s: above clear (1/s), below engage (5/s)
+    ctl.tick()
+    assert ctl.level == 2
+
+    # two clean windows per downward step
+    clock[0] = 5.0
+    ctl.tick()
+    assert ctl.level == 2  # one clean window is not enough
+    clock[0] = 6.0
+    ctl.tick()
+    assert ctl.level == 1
+    # hysteresis: the middle band also restarts the count mid-descent
+    clock[0] = 7.0
+    count[0] += 3.0
+    ctl.tick()
+    clock[0] = 8.0
+    ctl.tick()
+    assert ctl.level == 1
+    clock[0] = 9.0
+    ctl.tick()
+    assert ctl.level == 0
+    assert applied == [1, 2, 1, 0]
+    assert ctl._c_transitions.value(direction="up") == 2
+    assert ctl._c_transitions.value(direction="down") == 2
+
+    d = ctl.as_dict()
+    assert d["level"] == 0 and d["active"] is False
+    assert d["engage_per_s"] == 5.0 and d["max_level"] == 2
+
+
+def test_rebound_counter_reset_not_negative_pressure():
+    """A source counter restarting from zero (runtime re-bind) must not
+    produce a negative delta that masks real pressure from the other
+    sources."""
+    clock = [0.0]
+    a, b = [1000.0], [0.0]
+    ctl = DegradationController(
+        sources=[("a", lambda: a[0]), ("b", lambda: b[0])],
+        apply_level=lambda lvl: None, registry=Registry(),
+        window_s=1.0, engage_per_s=5.0, clock=lambda: clock[0])
+    clock[0] = 1.0
+    a[0] = 0.0       # re-bound: would read as -1000/s
+    b[0] = 10.0      # real pressure: 10/s
+    ctl.tick()
+    assert ctl.level == 1
+
+
+def test_attach_runtime_levers_shrink_and_restore_exactly():
+    """attach_runtime wires the real levers: batch size and mempool
+    ceilings halve per level (floored), and level 0 restores the exact
+    configured bases; /status carries the controller state."""
+    from hbbft_tpu.net.cluster import (
+        ClusterConfig, build_runtime, generate_infos,
+    )
+
+    cfg = ClusterConfig(n=4, seed=21, batch_size=32,
+                        max_tx_bytes=64 * 1024)
+    rt = build_runtime(cfg, generate_infos(cfg), 0)
+    try:
+        ctl = rt.degrade
+        assert ctl is not None
+        algo = rt.sq.algo
+        base_batch = algo.batch_size
+        base_cap = rt.mempool.capacity
+        base_pending = rt.mempool.max_pending_bytes
+        assert base_batch == 32
+
+        ctl._set_level(1, "test")
+        assert algo.batch_size == 16
+        assert rt.mempool.capacity == max(64, base_cap >> 1)
+        assert rt.mempool.max_pending_bytes == base_pending >> 1
+        assert ctl.batch_size() == 16
+
+        ctl._set_level(3, "test")
+        assert algo.batch_size == 8  # min_batch floor
+
+        ctl._set_level(0, "test")
+        assert algo.batch_size == base_batch
+        assert rt.mempool.capacity == base_cap
+        assert rt.mempool.max_pending_bytes == base_pending
+
+        doc = rt.status_doc()
+        assert doc["degraded"]["level"] == 0
+        assert doc["degraded"]["batch_size"] == base_batch
+    finally:
+        rt.transport.registry = None  # nothing started; nothing to stop
+
+
+def test_degrade_opt_out_and_custom_knobs():
+    from hbbft_tpu.net.cluster import (
+        ClusterConfig, build_runtime, generate_infos,
+    )
+
+    cfg = ClusterConfig(n=4, seed=22)
+    infos = generate_infos(cfg)
+    rt_off = build_runtime(cfg, infos, 0, degrade=False)
+    assert rt_off.degrade is None
+    assert rt_off.status_doc()["degraded"] is None
+
+    rt_knobs = build_runtime(
+        cfg, infos, 1,
+        degrade_kwargs=dict(engage_per_s=99.0, max_level=1))
+    assert rt_knobs.degrade.engage_per_s == 99.0
+    assert rt_knobs.degrade.max_level == 1
+
+
+@pytest.mark.slow
+def test_flood_shrinks_batch_then_restores_e2e():
+    """The acceptance drill: a sustained garbage flood from a
+    compromised validator identity drives the victim's ladder up
+    (batch size shrinks), the cluster keeps committing throughout, and
+    once the flood stops the ladder walks back to level 0 with the
+    exact configured batch size restored."""
+    from hbbft_tpu.net.cluster import (
+        ClusterConfig, LocalCluster, node_secret_key,
+    )
+    from hbbft_tpu.sim.adversary import GarbageStreamAdversary
+
+    async def scenario():
+        cfg = ClusterConfig(
+            n=4, seed=31, batch_size=16, max_tx_bytes=64 * 1024,
+            # tight guard budgets so the flood registers as pressure
+            # within a short run (the campaign's flood-cell idiom)
+            ingress_bytes_per_s=64 * 1024,
+            ingress_burst_bytes=32 * 1024,
+            ingress_decode_strikes=40,
+        )
+        cluster = LocalCluster(cfg, degrade_kwargs=dict(
+            window_s=0.3, engage_per_s=20.0,
+            clear_per_s=2.0, clear_windows=2))
+        await cluster.start()
+        injector = None
+        try:
+            client = await cluster.client(1)
+            await client.submit(b"tx-before-flood")
+            await client.wait_committed(b"tx-before-flood", timeout_s=60)
+
+            victim = cluster.runtimes[0]
+            base_batch = victim.sq.algo.batch_size
+            assert base_batch == 16 and victim.degrade.level == 0
+
+            # compromised validator: correct node id AND its real key,
+            # so the flood passes the auth challenge and the pressure
+            # drill runs against the post-auth guard layer
+            injector = GarbageStreamAdversary(
+                seed=5, budget_frames=200_000, frame_bytes=512,
+                secret_key=node_secret_key(cfg, cfg.n - 1))
+            task = asyncio.ensure_future(injector.run(
+                cluster.addrs[0], cfg.cluster_id,
+                identity=cfg.n - 1, duration_s=30.0))
+
+            for _ in range(600):  # ≤ 15 s for the ladder to engage
+                if victim.degrade.level > 0:
+                    break
+                await asyncio.sleep(0.025)
+            assert victim.degrade.level > 0, "flood never engaged"
+            assert victim.sq.algo.batch_size < base_batch
+
+            # degraded, not dead: commits continue under flood
+            await client.submit(b"tx-during-flood")
+            await client.wait_committed(b"tx-during-flood", timeout_s=60)
+
+            injector.budget_frames = 0  # stop the flood
+            await asyncio.wait_for(task, 10.0)
+
+            for _ in range(800):  # ≤ 20 s to walk back down
+                if victim.degrade.level == 0:
+                    break
+                await asyncio.sleep(0.025)
+            assert victim.degrade.level == 0, "ladder never cleared"
+            assert victim.sq.algo.batch_size == base_batch
+
+            up = victim.degrade._c_transitions.value(direction="up")
+            down = victim.degrade._c_transitions.value(direction="down")
+            assert up >= 1 and up == down
+
+            await client.submit(b"tx-after-recovery")
+            await client.wait_committed(b"tx-after-recovery",
+                                        timeout_s=60)
+        finally:
+            if injector is not None:
+                injector.budget_frames = 0
+            await cluster.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 240))

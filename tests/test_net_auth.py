@@ -1,0 +1,510 @@
+"""Authenticated transport: signed hellos, spoof refusal, era grace.
+
+The protocol-v3 handshake contract (`net/framing.py` +
+`net/transport.py`): every node-role hello is CHALLENGEd, the dialer
+must sign the transcript with the claimed validator's per-era key, and
+every refusal is counted under exactly one
+``hbbft_guard_auth_failures_total`` reason WITHOUT allocating any
+per-peer state — a spoofer must never touch the impersonated
+validator's budgets, strikes, or backoff gates.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from hbbft_tpu.crypto import tc
+from hbbft_tpu.net import framing
+from hbbft_tpu.net.transport import EraKeyRing, Transport
+
+CLUSTER = b"auth-cl"
+
+
+def _secrets(n, salt=0):
+    return {
+        i: tc.SecretKey.random(random.Random(9000 + salt * 100 + i))
+        for i in range(n)
+    }
+
+
+def _make_auth(our_id, secrets, era_ref, ring, cluster_id=CLUSTER):
+    """(auth_sign, auth_verify) callbacks over a mutable ``[era]`` box
+    and an :class:`EraKeyRing` — NodeRuntime's wiring without the
+    protocol stack (same verdict ladder, incl. the lenient era-mismatch
+    fallback for honest-but-behind peers with still-valid keys)."""
+
+    def sign(cid, nonce, session):
+        era = era_ref[0]
+        t = framing.auth_transcript(cid, nonce, session, our_id,
+                                    framing.ROLE_NODE, era)
+        return era, secrets[our_id].sign(t).to_bytes()
+
+    def verify(node_id, role, era, sig_bytes, nonce, session):
+        try:
+            sig = tc.Signature.from_bytes(bytes(sig_bytes))
+        except (ValueError, IndexError):
+            return "bad_sig"
+        t = framing.auth_transcript(cluster_id, nonce, session,
+                                    node_id, role, int(era))
+        candidates = ring.lookup(node_id)
+        if not candidates:
+            return "unknown_key"
+        era_matched = False
+        for cand_era, key, stale in candidates:
+            if cand_era != era:
+                continue
+            era_matched = True
+            if key.verify(sig, t):
+                return "stale" if stale else "ok"
+        if not era_matched:
+            for cand_era, key, stale in candidates:
+                if not stale and key.verify(sig, t):
+                    return "stale"
+        return "bad_sig"
+
+    return sign, verify
+
+
+def _ring_over(state, grace_s=30.0, clock=None):
+    return EraKeyRing(
+        lambda: (state["era"], {i: sk.public_key()
+                                for i, sk in state["keys"].items()}),
+        grace_s=grace_s,
+        **({"clock": clock} if clock is not None else {}),
+    )
+
+
+# ===========================================================================
+# EraKeyRing unit
+# ===========================================================================
+
+
+def test_era_keyring_grace_window_and_single_prev():
+    ks0, ks1 = _secrets(1, salt=0)[0], _secrets(1, salt=1)[0]
+    ks2 = _secrets(1, salt=2)[0]
+    clock = [0.0]
+    state = {"era": 0, "keys": {7: ks0}}
+    ring = _ring_over(state, grace_s=10.0, clock=lambda: clock[0])
+
+    cands = ring.lookup(7)
+    assert [(e, s) for e, _k, s in cands] == [(0, False)]
+    assert ring.lookup("nobody") == []
+
+    # rotation: previous era admissible within grace, flagged stale
+    state["era"], state["keys"] = 1, {7: ks1}
+    cands = ring.lookup(7)
+    assert [(e, s) for e, _k, s in cands] == [(1, False), (0, True)]
+
+    # grace expiry on the clock
+    clock[0] = 11.0
+    assert [(e, s) for e, _k, s in ring.lookup(7)] == [(1, False)]
+
+    # exactly ONE previous era retained: a second rotation evicts era 1
+    state["era"], state["keys"] = 2, {7: ks2}
+    clock[0] = 12.0
+    cands = ring.lookup(7)
+    assert [(e, s) for e, _k, s in cands] == [(2, False), (1, True)]
+
+
+# ===========================================================================
+# Authenticated transport end to end
+# ===========================================================================
+
+
+def test_authenticated_transports_connect_and_heartbeat():
+    """Two auth-wired transports handshake, exchange messages, and run
+    session-bound heartbeats without a single auth failure."""
+
+    async def scenario():
+        secrets = _secrets(2)
+        state = {"era": 0, "keys": secrets}
+        got_a, got_b = [], []
+        ts = []
+        for our, sink in ((0, got_a), (1, got_b)):
+            sign, verify = _make_auth(our, secrets, [0],
+                                      _ring_over(state))
+            ts.append(Transport(
+                our, CLUSTER, heartbeat_s=0.05,
+                on_peer_message=lambda pid, d, s=sink: s.append(d),
+                auth_sign=sign, auth_verify=verify))
+        ta, tb = ts
+        await ta.listen()
+        await tb.listen()
+        ta.add_peer(1, tb.addr)
+        tb.add_peer(0, ta.addr)
+        ta.send(1, b"ping-payload")
+        tb.send(0, b"pong-payload")
+        for _ in range(400):
+            if got_a and got_b:
+                break
+            await asyncio.sleep(0.01)
+        assert got_a == [b"pong-payload"]
+        assert got_b == [b"ping-payload"]
+        # both acceptors verified a signed hello
+        assert ta.ingress._c_auth_ok.total() >= 1
+        assert tb.ingress._c_auth_ok.total() >= 1
+        # several session-bound heartbeats round-trip cleanly
+        await asyncio.sleep(0.3)
+        for t in (ta, tb):
+            doc = t.ingress.as_dict()
+            assert doc["auth_failures"]["session"] == 0
+            assert sum(doc["auth_failures"].values()) == 0
+        await ta.stop()
+        await tb.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 20))
+
+
+def test_prev_era_key_accepted_within_grace_counted_stale():
+    """A dialer still signing with the PREVIOUS era's key during a
+    rotation connects (grace window) and is counted stale — not refused
+    into a retry storm."""
+
+    async def scenario():
+        old = _secrets(2, salt=0)
+        new = dict(old)
+        new[0] = _secrets(1, salt=5)[0]  # node 0 re-keyed
+        state = {"era": 0, "keys": old}
+        ring_b = _ring_over(state, grace_s=30.0)
+        ring_b.lookup(0)  # prime the ring on era 0
+        state["era"], state["keys"] = 1, new  # rotation lands on B
+
+        sign_a, _ = _make_auth(0, old, [0], _ring_over(
+            {"era": 0, "keys": old}))
+        _, verify_b = _make_auth(1, new, [1], ring_b)
+        got = []
+        ta = Transport(0, CLUSTER, auth_sign=sign_a)
+        tb = Transport(1, CLUSTER,
+                       on_peer_message=lambda pid, d: got.append(d),
+                       auth_verify=verify_b)
+        await ta.listen()
+        await tb.listen()
+        ta.add_peer(1, tb.addr)
+        tb.add_peer(0, ta.addr)  # peer must be known for accept
+        ta.send(1, b"old-era-hello")
+        for _ in range(400):
+            if got:
+                break
+            await asyncio.sleep(0.01)
+        assert got == [b"old-era-hello"]
+        assert tb.ingress._c_auth_stale.total() == 1
+        assert sum(tb.ingress.as_dict()["auth_failures"].values()) == 0
+        await ta.stop()
+        await tb.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 20))
+
+
+# ===========================================================================
+# Handshake fuzz storm — every refusal counted, zero retained state
+# ===========================================================================
+
+
+def _node_hello_frame(node_id, era=0):
+    hello = framing.Hello(node_id=node_id, role=framing.ROLE_NODE,
+                          cluster_id=CLUSTER, era=era, epoch=0)
+    return framing.encode_frame(framing.HELLO,
+                                framing.encode_hello(hello),
+                                framing.DEFAULT_MAX_FRAME)
+
+
+async def _read_challenge(reader):
+    kind, payload = await asyncio.wait_for(
+        framing.read_one_frame(reader, framing.DEFAULT_MAX_FRAME), 3.0)
+    assert kind == framing.CHALLENGE
+    return framing.decode_challenge(payload)
+
+
+async def _expect_refusal(reader):
+    """After a bad answer the acceptor must close WITHOUT a hello
+    reply; a HELLO here means the spoof was accepted."""
+    try:
+        kind, _ = await asyncio.wait_for(
+            framing.read_one_frame(reader, framing.DEFAULT_MAX_FRAME),
+            3.0)
+    except (asyncio.IncompleteReadError, framing.FrameError,
+            ConnectionError, OSError):
+        return
+    assert kind != framing.HELLO, "spoofed handshake was ACCEPTED"
+
+
+def test_handshake_fuzz_storm_counted_and_stateless():
+    """Truncated / bit-flipped / replayed-nonce / wrong-era /
+    signature-stripped / AUTH-less hellos: each refused loudly, each
+    counted under one reason, and the guard's per-peer map stays EMPTY
+    afterwards — refused handshakes allocate nothing."""
+
+    async def scenario():
+        secrets = _secrets(2)
+        state = {"era": 0, "keys": secrets}
+        _, verify = _make_auth(0, secrets, [0], _ring_over(state))
+        t = Transport(0, CLUSTER, dead_after_s=1.0, auth_verify=verify)
+        await t.listen()
+        rng = random.Random(1234)
+
+        def transcript(nonce, session, node_id=1, era=0):
+            return framing.auth_transcript(CLUSTER, nonce, session,
+                                           node_id, framing.ROLE_NODE,
+                                           era)
+
+        async def probe(answer):
+            """hello → challenge → ``answer(nonce, session)`` frame
+            bytes (or b"" to just hang up) → expect refusal."""
+            reader, writer = await asyncio.open_connection(*t.addr)
+            try:
+                writer.write(_node_hello_frame(1))
+                await writer.drain()
+                nonce, session = await _read_challenge(reader)
+                frame = answer(nonce, session)
+                if frame:
+                    writer.write(frame)
+                    await writer.drain()
+                    await _expect_refusal(reader)
+            finally:
+                writer.close()
+
+        def auth_frame(era, sig):
+            return framing.encode_frame(
+                framing.AUTH, framing.encode_auth(era, sig),
+                framing.DEFAULT_MAX_FRAME)
+
+        # 1. garbage where the signature belongs
+        await probe(lambda n, s: auth_frame(
+            0, bytes(rng.randrange(256) for _ in range(96))))
+        # 2. bit-flipped valid signature
+        def flipped(nonce, session):
+            sig = bytearray(
+                secrets[1].sign(transcript(nonce, session)).to_bytes())
+            sig[3] ^= 0x40
+            return auth_frame(0, bytes(sig))
+        await probe(flipped)
+        # 3. replayed nonce: a signature over a DIFFERENT challenge
+        stale = secrets[1].sign(
+            transcript(b"\x01" * framing.NONCE_LEN,
+                       b"\x02" * framing.SESSION_LEN)).to_bytes()
+        await probe(lambda n, s: auth_frame(0, stale))
+        # 4. wrong era claim signed with the WRONG key
+        wrong = tc.SecretKey.random(random.Random(4242))
+        await probe(lambda n, s: auth_frame(
+            5, wrong.sign(transcript(n, s, era=5)).to_bytes()))
+        # 5. signature stripped (empty blob still decodes as AUTH)
+        await probe(lambda n, s: auth_frame(0, b""))
+        # 6. no AUTH at all: a protocol frame where the proof belongs
+        await probe(lambda n, s: framing.encode_frame(
+            framing.MSG, b"inject-before-auth",
+            framing.DEFAULT_MAX_FRAME))
+        # 7. unknown id, properly signed by a key the ring never held
+        async def probe_unknown():
+            reader, writer = await asyncio.open_connection(*t.addr)
+            try:
+                writer.write(_node_hello_frame(99))
+                await writer.drain()
+                nonce, session = await _read_challenge(reader)
+                sig = wrong.sign(
+                    transcript(nonce, session, node_id=99)).to_bytes()
+                writer.write(auth_frame(0, sig))
+                await writer.drain()
+                await _expect_refusal(reader)
+            finally:
+                writer.close()
+        await probe_unknown()
+        # 8. truncated AUTH frame: length prefix promises more bytes
+        async def probe_truncated():
+            reader, writer = await asyncio.open_connection(*t.addr)
+            try:
+                writer.write(_node_hello_frame(1))
+                await writer.drain()
+                await _read_challenge(reader)
+                whole = auth_frame(0, b"\x00" * 96)
+                writer.write(whole[: len(whole) // 2])
+                await writer.drain()
+            finally:
+                writer.close()
+            await asyncio.sleep(0.1)
+        await probe_truncated()
+
+        # drain the refusal paths, then audit the ledger
+        await asyncio.sleep(0.3)
+        doc = t.ingress.as_dict()
+        fails = doc["auth_failures"]
+        assert fails["bad_sig"] >= 5     # probes 1,2,3,4,5
+        assert fails["no_auth"] == 1     # probe 6
+        assert fails["unknown_key"] == 1  # probe 7
+        assert fails["malformed"] >= 1   # probe 8
+        assert sum(fails.values()) == 8  # one per probe, no doubles
+        assert doc["auth_ok"] == 0
+        # the spoof-proof core: NOTHING was allocated or charged
+        assert doc["peers"] == {}
+        assert t._senders == {}
+        assert t._half_open == 0
+        await t.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 30))
+
+
+def test_mid_handshake_kill_is_one_counted_refusal():
+    """A dialer that dies between CHALLENGE and AUTH costs exactly one
+    counted refusal and no state."""
+
+    async def scenario():
+        secrets = _secrets(2)
+        state = {"era": 0, "keys": secrets}
+        _, verify = _make_auth(0, secrets, [0], _ring_over(state))
+        t = Transport(0, CLUSTER, dead_after_s=0.4, auth_verify=verify)
+        await t.listen()
+        reader, writer = await asyncio.open_connection(*t.addr)
+        writer.write(_node_hello_frame(1))
+        await writer.drain()
+        await _read_challenge(reader)
+        writer.close()  # die mid-handshake
+        await asyncio.sleep(0.8)
+        doc = t.ingress.as_dict()
+        assert sum(doc["auth_failures"].values()) == 1
+        assert doc["auth_failures"]["malformed"] \
+            + doc["auth_failures"]["timeout"] == 1
+        assert doc["peers"] == {}
+        assert t._half_open == 0
+        await t.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 20))
+
+
+def test_hijacked_stream_wrong_session_ping_torn_down():
+    """An attacker who completes the handshake (compromised key) still
+    cannot ride heartbeats with a forged session id: the first PING
+    carrying the wrong session is refused, counted, and the stream is
+    torn down."""
+
+    async def scenario():
+        import struct
+
+        secrets = _secrets(2)
+        state = {"era": 0, "keys": secrets}
+        _, verify = _make_auth(0, secrets, [0], _ring_over(state))
+
+        # a throwaway listener so peer resolution has an address for
+        # the "compromised validator" the attacker dials in as
+        async def _ignore(reader, writer):
+            await asyncio.sleep(10)
+
+        park = await asyncio.start_server(_ignore, "127.0.0.1", 0)
+        park_addr = park.sockets[0].getsockname()[:2]
+        t = Transport(0, CLUSTER, auth_verify=verify,
+                      peer_resolver=lambda nid: park_addr)
+        await t.listen()
+
+        reader, writer = await asyncio.open_connection(*t.addr)
+        writer.write(_node_hello_frame(1))
+        await writer.drain()
+        nonce, session = await _read_challenge(reader)
+        tr = framing.auth_transcript(CLUSTER, nonce, session, 1,
+                                     framing.ROLE_NODE, 0)
+        writer.write(framing.encode_frame(
+            framing.AUTH,
+            framing.encode_auth(0, secrets[1].sign(tr).to_bytes()),
+            framing.DEFAULT_MAX_FRAME))
+        await writer.drain()
+        kind, _ = await asyncio.wait_for(
+            framing.read_one_frame(reader, framing.DEFAULT_MAX_FRAME),
+            3.0)
+        assert kind == framing.HELLO  # genuine key: accepted
+        # now heartbeat with a FORGED session id
+        bogus = bytes(framing.SESSION_LEN) + struct.pack(">Q", 1)
+        assert bogus[:framing.SESSION_LEN] != session
+        writer.write(framing.encode_frame(
+            framing.PING, bogus, framing.DEFAULT_MAX_FRAME))
+        await writer.drain()
+        for _ in range(200):
+            if t.ingress.as_dict()["auth_failures"]["session"]:
+                break
+            await asyncio.sleep(0.01)
+        assert t.ingress.as_dict()["auth_failures"]["session"] == 1
+        writer.close()
+        park.close()
+        await t.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 20))
+
+
+def test_half_open_budget_refuses_over_cap():
+    """Stalled half-open handshakes hold a bounded number of slots;
+    connections past the cap are refused and counted, not queued."""
+
+    async def scenario():
+        secrets = _secrets(2)
+        state = {"era": 0, "keys": secrets}
+        _, verify = _make_auth(0, secrets, [0], _ring_over(state))
+        t = Transport(0, CLUSTER, dead_after_s=1.5, auth_verify=verify,
+                      max_half_open=1)
+        await t.listen()
+        # slot holder: connects, sends nothing
+        _r1, w1 = await asyncio.open_connection(*t.addr)
+        await asyncio.sleep(0.1)
+        # over cap: refused before its hello is even read
+        r2, w2 = await asyncio.open_connection(*t.addr)
+        w2.write(_node_hello_frame(1))
+        await w2.drain()
+        await _expect_refusal(r2)
+        for _ in range(200):
+            if t.ingress.as_dict()["auth_failures"]["half_open"]:
+                break
+            await asyncio.sleep(0.01)
+        assert t.ingress.as_dict()["auth_failures"]["half_open"] >= 1
+        w1.close()
+        w2.close()
+        await t.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 20))
+
+
+# ===========================================================================
+# Rotation-era grace, end to end (vote_to_readd DKG rotation)
+# ===========================================================================
+
+
+@pytest.mark.slow
+def test_restart_across_rotation_reconnects_via_stale_grace():
+    """Regression for the rotation-era edge: a node restarted from
+    scratch AFTER a vote_to_readd DKG rotation signs its hellos with
+    era 0 while the live peers are at era 1 — the acceptors must admit
+    it under the era-grace path (counted
+    ``hbbft_guard_auth_stale_era_total``), never refuse it into a
+    backoff storm, and the cluster must keep committing."""
+    from hbbft_tpu.net.cluster import (
+        ClusterConfig, LocalCluster, find_free_base_port,
+    )
+
+    async def scenario():
+        cfg = ClusterConfig(n=4, seed=11, batch_size=4,
+                            base_port=find_free_base_port(4),
+                            heartbeat_s=0.2, dead_after_s=2.0)
+        cluster = LocalCluster(cfg)
+        await cluster.start()
+        try:
+            client = await cluster.client(0)
+            await client.submit(b"pre-rotation")
+            await client.wait_committed(b"pre-rotation", timeout_s=60)
+            cluster.vote_to_readd()
+            await cluster.wait_snapshot(min_era=1, timeout_s=120)
+            # node 3 dies and restarts from genesis: era 0 signatures
+            await cluster.restart_node(3)
+            await client.submit(b"post-rotation")
+            await client.wait_committed(b"post-rotation", timeout_s=60)
+            await cluster.wait_epochs(min_batches=1, timeout_s=60)
+            stale = sum(
+                rt.transport.ingress._c_auth_stale.total()
+                for rt in cluster.runtimes)
+            assert stale >= 1, ("restarted node's era-0 handshakes "
+                                "should land on the grace path")
+            fails = {}
+            for rt in cluster.runtimes:
+                for k, v in (rt.transport.ingress.as_dict()
+                             ["auth_failures"].items()):
+                    fails[k] = fails.get(k, 0) + v
+            # refusal reasons that would indicate the grace path broke
+            assert fails["bad_sig"] == 0 and fails["unknown_key"] == 0
+        finally:
+            await cluster.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 300))
