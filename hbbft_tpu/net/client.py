@@ -312,6 +312,33 @@ class Mempool:
             self._client_fifo.pop(owner, None)
             self._fifo_stale.pop(owner, None)
 
+    def mark_committed_digests(self, digests) -> int:
+        """Complete pending txs by DIGEST — a relay tier (the gateway)
+        forwarding a node's ``TX_COMMIT`` only sees digests, never the
+        tx bytes.  Drops matching pending entries, records every digest
+        in the dedup window (a re-submission of a committed tx answers
+        DUPLICATE, same as :meth:`mark_committed`), and returns how many
+        pending entries were actually dropped."""
+        n = 0
+        with self._lock:
+            for digest in digests:
+                dropped = self._pending.pop(digest, None)
+                if dropped is not None:
+                    self.pending_bytes -= len(dropped)
+                    self._forget_owner(digest, len(dropped))
+                    n += 1
+                self._seen[digest] = None
+            while len(self._seen) > self.seen_cap:
+                self._seen.popitem(last=False)
+        return n
+
+    def has_pending(self, digest: bytes) -> bool:
+        """Is this digest still awaiting commit here?  (Relay tiers use
+        this to skip forwarding entries that were shed or completed
+        between enqueue and flush.)"""
+        with self._lock:
+            return digest in self._pending
+
     def mark_committed(self, txs) -> List[bytes]:
         """Drop committed txs from pending; returns their digests."""
         digests = []

@@ -114,6 +114,11 @@ class ClusterConfig:
     ingress_max_inflight: int = 0
     ingress_decode_strikes: int = 0
     ingress_throttle_strikes: int = 0
+    # per-peer ingress worker threads (net/ingress.py): framing + decode
+    # run off the event loop, feeding the pump decoded batches.  Off by
+    # default — it buys wall-clock only where spare cores exist (thread
+    # switches cost more than they save on a saturated single core)
+    ingress_workers: bool = False
     # transport authentication (net/transport.py security model):
     # node-role hellos are challenge–response proven with the per-era
     # keys; auth=False reverts to the identification-only legacy
@@ -317,6 +322,7 @@ def _shared_runtime_kwargs(cfg: ClusterConfig, nid: int) -> dict:
         aba_out_delay_s=cfg.aba_delay_for(nid),
         aba_out_classes=cfg.aba_out_classes,
         ingress_kwargs=cfg.ingress_kwargs(),
+        ingress_workers=cfg.ingress_workers,
         auth=cfg.auth,
         auth_grace_s=cfg.auth_grace_s,
         degrade=cfg.degrade,
@@ -649,6 +655,8 @@ def node_command(cfg: ClusterConfig, nid: int) -> List[str]:
         cmd += ["--chaos", cfg.chaos]
         if cfg.chaos_seed >= 0:
             cmd += ["--chaos-seed", str(cfg.chaos_seed)]
+    if cfg.ingress_workers:
+        cmd.append("--ingress-workers")
     if not cfg.auth:
         cmd.append("--no-auth")
     if cfg.auth_grace_s != 30.0:
@@ -901,6 +909,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--aba-out-classes", default="",
                     help="narrow --aba-out-delay to these phase classes "
                          "(comma list, e.g. aba_conf); empty = all aba_*")
+    ap.add_argument("--ingress-workers", action="store_true",
+                    help="decode inbound peer frames on per-peer worker "
+                         "threads instead of the event loop "
+                         "(net/ingress.py)")
     ap.add_argument("--no-auth", action="store_true",
                     help="disable the authenticated node handshake "
                          "(identification-only hellos — trusted "
@@ -938,6 +950,7 @@ def main(argv: Optional[List[str]] = None) -> None:
                          else ""),
         aba_out_delay_s=args.aba_out_delay,
         aba_out_classes=args.aba_out_classes,
+        ingress_workers=args.ingress_workers,
         auth=not args.no_auth,
         auth_grace_s=args.auth_grace_s,
         degrade=not args.no_degrade,

@@ -362,6 +362,7 @@ class NodeRuntime:
             seed=seed,
             hello_key=self.current_key,
             on_peer_message=self._on_peer_message,
+            on_peer_batch=self._on_peer_batch,
             on_peer_hello=self._on_peer_hello,
             on_client_frame=self._on_client_frame,
             on_client_gone=self._on_client_gone,
@@ -758,6 +759,15 @@ class NodeRuntime:
     def _on_peer_message(self, peer_id: NodeId, payload: bytes) -> None:
         self.pump.enqueue("msg", peer_id, payload)
 
+    def _on_peer_batch(self, peer_id: NodeId, items: List[Any]) -> None:
+        """Batch-handle fast path (event loop side): one transport chunk
+        — every MSG payload and MSG_BATCH sub-message it carried — is
+        ONE pump enqueue, so the pump sees one event per chunk instead
+        of one per message.  ``items`` are raw payload bytes, or
+        ``(payload, decoded_msg_or_None)`` pairs when ingress worker
+        threads pre-decode off the loop."""
+        self.pump.enqueue("msgs", peer_id, items)
+
     def _on_guard_event(self, kind: str, peer_id: NodeId,
                         detail: str) -> None:
         """Transport ingress-guard escalations (event loop side): queue
@@ -815,6 +825,8 @@ class NodeRuntime:
                     t0 = pc()
                     if kind == "msg":
                         self._process_peer_message(*args)
+                    elif kind == "msgs":
+                        self._process_peer_batch(*args)
                     elif kind == "input":
                         self._process_input(*args)
                     elif kind == "hello":
@@ -863,6 +875,13 @@ class NodeRuntime:
                 if kind == "msg":
                     rec.write('["msg",%d,"%s"]\n'
                               % (args[0], args[1].hex()))
+                elif kind == "msgs":
+                    # journal a batch as its per-message lines so replay
+                    # profiling stays format-compatible
+                    for it in args[1]:
+                        p = it[0] if type(it) is tuple else it
+                        rec.write('["msg",%d,"%s"]\n'
+                                  % (args[0], p.hex()))
                 elif kind == "input":
                     tx = getattr(args[0], "tx", None)
                     if tx is not None:
@@ -875,6 +894,8 @@ class NodeRuntime:
             w0 = pc()
             if kind == "msg":
                 self._process_peer_message(*args)
+            elif kind == "msgs":
+                self._process_peer_batch(*args)
             elif kind == "input":
                 self._process_input(*args)
             elif kind == "hello":
@@ -1042,7 +1063,7 @@ class NodeRuntime:
             timing["m_decode"] = timing.get("m_decode", 0.0) + (t1 - t0)
         self.spans.on_message(peer_id, msg)
         if self.flight is not None:
-            self.flight.on_message(peer_id, msg)
+            self.flight.on_message(peer_id, msg, payload=bytes(payload))
         if timing is not None:
             t2 = time.thread_time()
             timing["m_spans"] = timing.get("m_spans", 0.0) + (t2 - t1)
@@ -1064,6 +1085,92 @@ class NodeRuntime:
             self._absorb(step)
             timing["m_absorb"] = (
                 timing.get("m_absorb", 0.0) + (time.thread_time() - t3))
+            return
+        self._absorb(step)
+
+    def _process_peer_batch(self, peer_id: NodeId,
+                            items: List[Any]) -> None:
+        """One transport chunk's payloads as ONE pump unit: a single
+        in-flight retire, one :meth:`SenderQueue.handle_message_batch`
+        call merging the per-message Steps, one ``_absorb`` (one
+        spans/flight step pass, with ``_dispatch``'s broadcast-encode
+        cache shared across the whole batch).  Per-item error handling
+        matches :meth:`_process_peer_message` exactly — an undecodable,
+        non-sender-queue, or protocol-rejected item strikes THIS peer
+        and is skipped, never voiding the rest of the batch — and the
+        handle order is the socket order, so ledgers are byte-identical
+        with the per-message path."""
+        self.transport.ingress.frame_done(peer_id, len(items))
+        timing = self._pump_timing
+        t0 = time.thread_time() if timing is not None else 0.0
+        cache = self._decode_cache
+        strike = self.transport.ingress.decode_strike
+        msgs: List[Any] = []
+        payloads: Dict[int, bytes] = {}
+        for item in items:
+            if type(item) is tuple:
+                # ingress-worker pre-decoded (payload, msg|None) pair
+                payload, msg = item
+            else:
+                payload, msg = item, None
+            if msg is None:
+                msg = cache.get(payload)
+                if msg is None:
+                    try:
+                        msg = wire.decode_message(payload)
+                    except ValueError as exc:
+                        self.decode_failures += 1
+                        strike(peer_id)
+                        logger.warning("undecodable message from %r: %s",
+                                       peer_id, exc)
+                        continue
+                    if len(cache) >= 4096:
+                        cache.clear()
+                    cache[payload] = msg
+                elif timing is not None:
+                    timing["n_dec_hit"] = timing.get("n_dec_hit", 0) + 1
+            if not isinstance(msg, (AlgoMessage, EpochStarted)):
+                self.decode_failures += 1
+                strike(peer_id)
+                logger.warning("non-sender-queue message %s from %r",
+                               type(msg).__name__, peer_id)
+                continue
+            # keep the wire payload beside the message: the flight
+            # journal records it verbatim, skipping a re-encode (the
+            # decode cache may hand back one msg object for identical
+            # payloads — same bytes either way)
+            payloads[id(msg)] = payload
+            msgs.append(msg)
+        if timing is not None:
+            t1 = time.thread_time()
+            timing["m_decode"] = timing.get("m_decode", 0.0) + (t1 - t0)
+        if not msgs:
+            return
+        spans = self.spans
+        flight = self.flight
+
+        def pre(msg):
+            spans.on_message(peer_id, msg)
+            if flight is not None:
+                flight.on_message(peer_id, msg,
+                                  payload=payloads.get(id(msg)))
+
+        def on_error(msg, exc):
+            # decodable but protocol-unexpected: Byzantine input at the
+            # network boundary — count it, keep connection + batch alive
+            self.decode_failures += 1
+            strike(peer_id)
+            logger.warning("protocol-rejected message from %r: %s",
+                           peer_id, exc)
+
+        step = self.sq.handle_message_batch(peer_id, msgs, pre=pre,
+                                            on_error=on_error)
+        if timing is not None:
+            t2 = time.thread_time()
+            timing["m_handle"] = timing.get("m_handle", 0.0) + (t2 - t1)
+            self._absorb(step)
+            timing["m_absorb"] = (
+                timing.get("m_absorb", 0.0) + (time.thread_time() - t2))
             return
         self._absorb(step)
 

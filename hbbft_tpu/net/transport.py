@@ -678,6 +678,13 @@ class TransportStats:
         self._c_frames_recv.inc()
         self._c_bytes_recv.inc(nbytes)
 
+    def frame_recv_batch(self, nframes: int, nbytes: int) -> None:
+        """Batched receive accounting: one pair of counter bumps for a
+        whole decoded chunk instead of two per frame (the batch-handle
+        hot path; only the totals are observable either way)."""
+        self._c_frames_recv.inc(nframes)
+        self._c_bytes_recv.inc(nbytes)
+
     def egress_stall(self, peer_id: NodeId) -> None:
         self._egress_stalls.labels(peer=repr(peer_id)).inc()
 
@@ -746,25 +753,54 @@ class ClientConn:
         self._record_send = record_send
         self._stats = stats
         self.closed = False
+        # chunk-scoped write coalescing: between begin_batch/flush_batch
+        # frames accumulate and go out as ONE writer.write — a 16-tx
+        # submit wave answers with one ack syscall, not 16 (socket send
+        # is a measurable share of a small host's consensus budget)
+        self._batching = False
+        self._pending: List[bytes] = []
 
     def _drop(self) -> None:
         self.closed = True
         if self._stats is not None:
             self._stats.client_conn_drops += 1
 
-    def send(self, kind: int, payload: bytes) -> None:
-        if self.closed:
+    def begin_batch(self) -> None:
+        self._batching = True
+
+    def flush_batch(self) -> None:
+        self._batching = False
+        if not self._pending or self.closed:
+            self._pending.clear()
             return
+        buf = b"".join(self._pending)
+        self._pending.clear()
         try:
             if (self._writer.transport.get_write_buffer_size()
                     > self.MAX_WRITE_BUFFER):
                 self._drop()
                 self._writer.close()
                 return
+            self._writer.write(buf)
+        except (ConnectionError, RuntimeError):
+            self._drop()
+
+    def send(self, kind: int, payload: bytes) -> None:
+        if self.closed:
+            return
+        try:
             frame = framing.encode_frame(kind, payload, self._max_frame)
-            self._writer.write(frame)
             if self._record_send is not None:
                 self._record_send(self.client_id, frame)
+            if self._batching:
+                self._pending.append(frame)
+                return
+            if (self._writer.transport.get_write_buffer_size()
+                    > self.MAX_WRITE_BUFFER):
+                self._drop()
+                self._writer.close()
+                return
+            self._writer.write(frame)
         except (ConnectionError, RuntimeError):
             self._drop()
 
@@ -1056,6 +1092,169 @@ class _PeerSender:
                 await self.task
 
 
+class _NodeRecvProtocol(asyncio.Protocol):
+    """Post-handshake node receive path as a raw asyncio protocol.
+
+    Swapped onto the socket with ``set_protocol`` once the stream-based
+    handshake completes: every chunk is then one synchronous
+    ``data_received`` callback that decodes, admits, and delivers the
+    whole chunk's consensus payloads as a single batch (or feeds a
+    per-peer ingress worker thread when the transport runs with
+    ``ingress_workers``).  The IngressBudget verdicts map onto transport
+    flow control: a throttle delay or an in-flight-cap breach pauses
+    reading (closing the TCP window — real backpressure) and a timer
+    re-polls until the pump drains the window or the strike ladder
+    trips.  ``done`` resolves when the connection ends, carrying the
+    same exception shapes the old StreamReader loop raised so the
+    caller's drop accounting is untouched.
+    """
+
+    __slots__ = ("t", "peer_id", "writer", "decoder", "state", "session",
+                 "worker", "loop", "done", "transport", "timing",
+                 "seg_recv", "_paused", "_resume_handle")
+
+    def __init__(self, t: "Transport", peer_id: NodeId,
+                 writer: asyncio.StreamWriter, decoder: FrameDecoder,
+                 state: list, session: Optional[bytes],
+                 worker: Optional[Any] = None):
+        self.t = t
+        self.peer_id = peer_id
+        self.writer = writer
+        self.decoder = decoder
+        self.state = state  # shared with _idle_watchdog
+        self.session = session
+        self.worker = worker
+        self.loop = asyncio.get_running_loop()
+        self.done: asyncio.Future = self.loop.create_future()
+        self.transport: Optional[asyncio.BaseTransport] = None
+        # cached per-connection: the runtime wires these before serving
+        self.timing = getattr(t, "timing", None)
+        self.seg_recv = getattr(t, "seg_recv", None)
+        self._paused = False
+        self._resume_handle: Optional[asyncio.TimerHandle] = None
+
+    def connection_made(self, transport: asyncio.BaseTransport) -> None:
+        self.transport = transport
+
+    def data_received(self, data: bytes) -> None:
+        if self.done.done():
+            return
+        self.state[0] = time.monotonic()
+        t = self.t
+        if self.worker is not None:
+            # decode happens off-loop; only byte-rate accounting and
+            # flow control stay here
+            self.worker.feed(data)
+            if self.worker.backlog_over():
+                # bounded hand-off queue: a slow worker closes the TCP
+                # window instead of buffering unboundedly
+                self._pause(0.01)
+        else:
+            try:
+                if self.timing is None and self.seg_recv is None:
+                    t._recv_chunk(self.peer_id, self.writer,
+                                  self.decoder, data,
+                                  session=self.session)
+                else:
+                    w0 = time.perf_counter()
+                    t0 = (time.thread_time()
+                          if self.timing is not None else 0.0)
+                    t._recv_chunk(self.peer_id, self.writer,
+                                  self.decoder, data,
+                                  session=self.session)
+                    if self.timing is not None:
+                        self.timing["recv"] = (
+                            self.timing.get("recv", 0.0)
+                            + (time.thread_time() - t0))
+                        self.timing["n_recv"] = (
+                            self.timing.get("n_recv", 0) + 1)
+                    if self.seg_recv is not None:
+                        self.seg_recv(time.perf_counter() - w0)
+            except (FrameError, ValueError) as exc:
+                # same exception set the stream loop let propagate to
+                # the acceptor's drop accounting
+                self._fail(exc)
+                return
+        guard = t.ingress
+        delay = guard.charge(self.peer_id, len(data))
+        if guard.kill_pending(self.peer_id):
+            self._fail(FrameError(
+                f"ingress budget exceeded by peer {self.peer_id!r}"
+            ))
+            return
+        if delay > 0 or guard.inflight_over(self.peer_id):
+            self._pause(delay if delay > 0 else 0.05)
+
+    def _pause(self, delay: float) -> None:
+        if self._paused or self.transport is None:
+            return
+        self._paused = True
+        self.transport.pause_reading()
+        self._resume_handle = self.loop.call_later(
+            delay, self._maybe_resume)
+
+    def _maybe_resume(self) -> None:
+        """Timer path of the in-flight cap: re-poll the guard until the
+        pump retires this peer's admitted frames.  Each wait cycle is a
+        counted strike (``charge(peer, 0)``), so a wedged consumer or a
+        flood the pump cannot keep up with escalates to the disconnect
+        ladder instead of pausing forever — same ladder the old polling
+        loop walked."""
+        self._resume_handle = None
+        if self.done.done() or self.transport is None:
+            return
+        self.state[0] = time.monotonic()  # a throttle is not idleness
+        if self.worker is not None and self.worker.backlog_over():
+            # our own worker is behind, not the peer misbehaving: wait
+            # without charging the peer's strike ladder
+            self._resume_handle = self.loop.call_later(
+                0.01, self._maybe_resume)
+            return
+        guard = self.t.ingress
+        if guard.inflight_over(self.peer_id):
+            delay = guard.charge(self.peer_id, 0)
+            if guard.kill_pending(self.peer_id):
+                self._fail(FrameError(
+                    f"in-flight frame cap exceeded by peer "
+                    f"{self.peer_id!r}"
+                ))
+                return
+            self._resume_handle = self.loop.call_later(
+                delay if delay > 0 else 0.05, self._maybe_resume)
+            return
+        self._paused = False
+        self.transport.resume_reading()
+
+    def _fail(self, exc: BaseException) -> None:
+        """Terminate the connection with ``exc`` as the recv outcome
+        (thread-safe callers schedule this via call_soon_threadsafe)."""
+        if not self.done.done():
+            self.done.set_exception(exc)
+        if self.transport is not None:
+            self.transport.close()
+
+    def eof_received(self) -> bool:
+        return False  # close on EOF, like reader.read() returning b""
+
+    def connection_lost(self, exc: Optional[Exception]) -> None:
+        if self._resume_handle is not None:
+            self._resume_handle.cancel()
+            self._resume_handle = None
+        if self.worker is not None:
+            self.worker.stop()
+        if self.done.done():
+            return
+        if self.state[1]:
+            # the idle watchdog closed us: surface the same timeout the
+            # stream loop raised so drop accounting is unchanged
+            self.done.set_exception(asyncio.TimeoutError(
+                f"peer {self.peer_id!r} recv idle timeout"))
+        elif exc is not None:
+            self.done.set_exception(exc)
+        else:
+            self.done.set_result(None)
+
+
 class Transport:
     """The node's socket layer: one listener + one sender per peer."""
 
@@ -1067,6 +1266,10 @@ class Transport:
         seed: int = 0,
         hello_key: Callable[[], Tuple[int, int]] = lambda: (0, 0),
         on_peer_message: Optional[Callable[[NodeId, bytes], None]] = None,
+        on_peer_batch: Optional[
+            Callable[[NodeId, List[Any]], None]
+        ] = None,
+        ingress_workers: bool = False,
         on_peer_hello: Optional[
             Callable[[NodeId, Hello, str], None]
         ] = None,
@@ -1103,6 +1306,14 @@ class Transport:
         self.cluster_id = bytes(cluster_id)
         self.hello_key = hello_key
         self.on_peer_message = on_peer_message
+        # batch-handle fast path: when set, each network chunk delivers its
+        # whole decoded MSG/MSG_BATCH content as ONE callback (a list of
+        # payloads, or (payload, pre_decoded) pairs from ingress workers)
+        # instead of N per-message callbacks — one pump enqueue per chunk
+        self.on_peer_batch = on_peer_batch
+        # move framing/CRC/decode work off the event loop onto per-peer
+        # worker threads (net/ingress.py); requires on_peer_batch
+        self.ingress_workers = bool(ingress_workers)
         self.on_peer_hello = on_peer_hello
         self.on_client_frame = on_client_frame
         self.on_client_gone = on_client_gone
@@ -1470,10 +1681,58 @@ class Transport:
             self._idle_watchdog(writer, state, idle_timeout)
         )
         try:
-            await self._node_recv_inner(peer_id, reader, writer,
-                                        decoder, state, session)
+            tr = writer.transport
+            if hasattr(tr, "set_protocol"):
+                await self._node_recv_proto(peer_id, reader, writer, tr,
+                                            decoder, state, session)
+            else:
+                # non-socket transports (test doubles) keep the
+                # stream-reader loop
+                await self._node_recv_inner(peer_id, reader, writer,
+                                            decoder, state, session)
         finally:
             watchdog.cancel()
+
+    async def _node_recv_proto(self, peer_id: NodeId,
+                               reader: asyncio.StreamReader,
+                               writer: asyncio.StreamWriter,
+                               tr: asyncio.BaseTransport,
+                               decoder: FrameDecoder, state: list,
+                               session: Optional[bytes]) -> None:
+        """Steady-state node receive via a raw asyncio protocol.
+
+        After the (stream-based, cold-path) handshake the connection is
+        upgraded in place with ``set_protocol``: chunks then arrive as
+        direct ``data_received`` callbacks — no StreamReader buffer
+        append + task wakeup + 64 KiB ``read()`` future round-trip per
+        chunk, which was a measurable slice of per-epoch loop CPU.
+        Bytes the StreamReader already buffered are drained into the
+        protocol first (no await between the buffer grab and the
+        protocol swap, so no chunk can interleave)."""
+        worker = None
+        if self.ingress_workers and self.on_peer_batch is not None:
+            from hbbft_tpu.net.ingress import PeerIngressWorker
+
+            worker = PeerIngressWorker(self, peer_id, writer, session)
+        proto = _NodeRecvProtocol(self, peer_id, writer, decoder,
+                                  state, session, worker)
+        if worker is not None:
+            worker.bind(proto)
+        leftover = bytes(reader._buffer)
+        del reader._buffer[:]
+        tr.set_protocol(proto)
+        proto.connection_made(tr)
+        if hasattr(tr, "is_reading") and not tr.is_reading():
+            # the StreamReader's flow control may have paused the socket
+            # with its buffer full; the new protocol owns pausing now
+            tr.resume_reading()
+        if leftover:
+            proto.data_received(leftover)
+        try:
+            await proto.done
+        finally:
+            if worker is not None:
+                worker.stop()
 
     async def _node_recv_inner(self, peer_id: NodeId,
                                reader: asyncio.StreamReader,
@@ -1542,9 +1801,27 @@ class Transport:
         """One chunk of the node recv path — synchronous on purpose: the
         PONG reply is written without an awaited drain (a 15-byte reply
         to a rare heartbeat; asyncio flushes it on the next loop pass),
-        which keeps the whole per-chunk path free of coroutine hops."""
-        for kind, payload in decoder.feed(data):
-            self._record_recv(peer_id, kind, payload)
+        which keeps the whole per-chunk path free of coroutine hops.
+
+        With ``on_peer_batch`` set, every consensus payload decoded from
+        this chunk is admitted and delivered as ONE list (one ingress
+        lock round, one runtime callback, one pump enqueue) — the
+        batch-handle fast path.  Without it, the legacy per-message
+        ``on_peer_message`` callback fires per payload (raw-transport
+        tests and embedders rely on that shape)."""
+        frames = decoder.feed(data)
+        # per-frame recv accounting only when a trace or cost model is
+        # attached (they need kind + per-frame granularity); the plain
+        # path batches the two counter bumps for the whole chunk
+        heavy = self.trace is not None or self.cost_model is not None
+        batch: Optional[List[Any]] = (
+            [] if self.on_peer_batch is not None else None)
+        nbytes = 0
+        for kind, payload in frames:
+            if heavy:
+                self._record_recv(peer_id, kind, payload)
+            else:
+                nbytes += len(payload) + 5
             if kind == framing.PING:
                 if session is not None and (
                         len(payload) != framing.SESSION_LEN + 8
@@ -1564,11 +1841,15 @@ class Transport:
                 writer.write(pong)
                 self._record_send(peer_id, pong)
             elif kind == framing.MSG:
-                if self.on_peer_message is not None:
+                if batch is not None:
+                    batch.append(payload)
+                elif self.on_peer_message is not None:
                     self.ingress.frame_admitted(peer_id)
                     self.on_peer_message(peer_id, payload)
             elif kind == framing.MSG_BATCH:
-                if self.on_peer_message is not None:
+                if batch is not None:
+                    batch.extend(framing.split_msgs(payload))
+                elif self.on_peer_message is not None:
                     for sub in framing.split_msgs(payload):
                         self.ingress.frame_admitted(peer_id)
                         self.on_peer_message(peer_id, sub)
@@ -1577,6 +1858,11 @@ class Transport:
                     f"unexpected frame kind {kind} from node "
                     f"{peer_id!r}"
                 )
+        if not heavy and frames:
+            self.stats.frame_recv_batch(len(frames), nbytes)
+        if batch:
+            self.ingress.frame_admitted(peer_id, len(batch))
+            self.on_peer_batch(peer_id, batch)
 
     async def _client_recv_loop(self, hello: Hello,
                                 reader: asyncio.StreamReader,
@@ -1600,7 +1886,12 @@ class Transport:
                             f"client {hello.node_id!r} recv idle timeout")
                     return
                 state[0] = time.monotonic()
-                for kind, payload in decoder.feed(data):
+                frames = decoder.feed(data)
+                if len(frames) > 1:
+                    # one reply syscall per CHUNK: a submit wave's acks
+                    # coalesce instead of hitting the socket per tx
+                    conn.begin_batch()
+                for kind, payload in frames:
                     self._record_recv(hello.node_id, kind, payload)
                     if kind == framing.PING:
                         conn.send(framing.PONG, payload)
@@ -1621,6 +1912,7 @@ class Transport:
                                   framing.encode_auth(era, sig))
                     elif self.on_client_frame is not None:
                         self.on_client_frame(conn, kind, payload)
+                conn.flush_batch()
         finally:
             watchdog.cancel()
             conn.closed = True

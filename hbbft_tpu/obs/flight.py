@@ -262,7 +262,11 @@ class FlightRecorder:
         self.clock = clock
         self.max_segment_bytes = max_segment_bytes
         self.max_segments = max_segments
-        self.tail: "deque[Dict[str, Any]]" = deque(maxlen=tail_records)
+        # raw records, dict-ified lazily at read time: the tail is read
+        # rarely (``/flight`` tails, audits) but appended on EVERY hot-path
+        # message — eager record_as_dict (a sha3 per payload) was ~5% of
+        # node CPU under load
+        self.tail: "deque[Any]" = deque(maxlen=tail_records)
         self._seq = 0
         self._fh = None
         self._seg_bytes = 0
@@ -274,8 +278,12 @@ class FlightRecorder:
             "hbbft_obs_flight_records_total",
             "journal records appended, by record type",
             labelnames=("type",), max_label_sets=len(RECORD_TYPES) + 1)
-        for cls in RECORD_TYPES:
-            self._c_records.labels(type=cls.__name__)
+        # pre-resolved per-type children: .labels() re-validates the
+        # label set on every call, and _append is per-message hot
+        self._rec_counters = {
+            cls.__name__: self._c_records.labels(type=cls.__name__)
+            for cls in RECORD_TYPES
+        }
         self._c_bytes = r.counter(
             "hbbft_obs_flight_bytes_total",
             "journal bytes appended (framing included)")
@@ -450,9 +458,14 @@ class FlightRecorder:
                 self._c_write_fail.inc()
         else:
             self._c_write_fail.inc()
-        self._c_records.labels(type=type(rec).__name__).inc()
+        self._rec_counters[type(rec).__name__].inc()
         self._c_bytes.inc(len(frame))
-        self.tail.append(record_as_dict(rec))
+        # small records (the per-message hot path) go in raw and are
+        # dict-ified only when the tail is read; big ones (MB-scale RBC
+        # Value payloads) are summarized NOW so the tail can never pin
+        # hundreds of MB of payload bytes — 512 × 4 KiB caps it at ~2 MB
+        self.tail.append(rec if len(frame) <= 4096 else
+                         record_as_dict(rec))
         self._seg_bytes += len(frame)
         self._seg_records += 1
         # > 1: the segment-header hello alone must never trigger a rotate
@@ -462,12 +475,16 @@ class FlightRecorder:
             self._rotate()
 
     def record_msg(self, direction: str, peer: str, message: Any,
-                   t: Optional[float] = None) -> None:
-        try:
-            payload = wire.encode_message(message)
-        except TypeError:
-            self._c_encode_skip.inc()
-            payload = b""
+                   t: Optional[float] = None,
+                   payload: Optional[bytes] = None) -> None:
+        # the receive path already HAS the wire payload it decoded the
+        # message from — callers pass it to skip a re-encode per message
+        if payload is None:
+            try:
+                payload = wire.encode_message(message)
+            except TypeError:
+                self._c_encode_skip.inc()
+                payload = b""
         era, epoch = message_epoch(message)
         self._append(FlightMsg(self._next_seq(), self._now(t), direction,
                                peer, era, epoch, type(message).__name__,
@@ -557,13 +574,19 @@ class FlightRecorder:
 
     def tail_jsonl(self) -> str:
         """Recent records as JSONL — the ``/flight`` endpoint body."""
-        return "\n".join(json.dumps(d) for d in self.tail) + (
-            "\n" if self.tail else "")
+        return "\n".join(
+            json.dumps(r if isinstance(r, dict) else record_as_dict(r))
+            for r in self.tail) + ("\n" if self.tail else "")
 
     def trace_jsonl(self) -> str:
         """The tail's FlightTrace records only — the ``/trace``
         endpoint body (per-tx causal stages, tids in hex)."""
-        rows = [d for d in self.tail if d.get("type") == "FlightTrace"]
+        rows = []
+        for r in self.tail:
+            if isinstance(r, FlightTrace):
+                rows.append(record_as_dict(r))
+            elif isinstance(r, dict) and r["type"] == "FlightTrace":
+                rows.append(r)
         return "\n".join(json.dumps(d) for d in rows) + (
             "\n" if rows else "")
 
@@ -605,11 +628,13 @@ class FlightObserver(StepObserver):
     # -- StepObserver --------------------------------------------------------
 
     def on_message(self, sender_id: Any, message: Any,
-                   t: Optional[float] = None) -> None:
+                   t: Optional[float] = None,
+                   payload: Optional[bytes] = None) -> None:
         if self.spans is not None:
             self.spans.on_message(sender_id, message, t)
         self._last_key = message_epoch(message)
-        self.recorder.record_msg("in", repr(sender_id), message, t=t)
+        self.recorder.record_msg("in", repr(sender_id), message, t=t,
+                                 payload=payload)
 
     def on_input(self, sender_id: Any, inp: Any,
                  t: Optional[float] = None) -> None:

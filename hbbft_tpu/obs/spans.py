@@ -356,11 +356,28 @@ class _ClassifyTypes:
         self.TermMsg = TermMsg
 
 
+_classify_memo: Tuple[Any, Any] = (None, None)
+
+
 def classify(message: Any
              ) -> Optional[Tuple[int, int, str, Optional[int]]]:
     """``(era, epoch, phase, round)`` for a consensus message, walking the
     wrapper chain; ``None`` for control traffic (EpochStarted, heartbeats)
     that belongs to no epoch phase."""
+    global _T, _classify_memo
+    # every inbound message is classified twice on the hot path (span
+    # tracer + flight-journal epoch attribution), back to back with the
+    # SAME object: a one-entry identity memo halves the wrapper walks
+    memo_key, memo_hit = _classify_memo
+    if memo_key is message:
+        return memo_hit
+    hit = _classify_walk(message)
+    _classify_memo = (message, hit)
+    return hit
+
+
+def _classify_walk(message: Any
+                   ) -> Optional[Tuple[int, int, str, Optional[int]]]:
     global _T
     T = _T
     if T is None:

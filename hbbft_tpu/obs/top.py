@@ -10,11 +10,17 @@ across nodes), which is the "where does the epoch latency go" line.
     python -m hbbft_tpu.obs.top --targets 127.0.0.1:26000,127.0.0.1:26001
     python -m hbbft_tpu.obs.top --base-port 26000 --nodes 4
 
+``--gateways host:port,…`` additionally polls client-gateway obs
+endpoints (a gateway started with ``--metrics-port`` serves the same
+``/status`` + ``/metrics`` shape) and renders a second table — clients,
+pending pool, forward queue, live node links, forwarded/relayed/shed
+totals — so the ingest tier shows up next to the nodes it feeds.
+
 ``--iterations N`` renders N frames then exits (``1`` = one plain snapshot,
 used by scripts/tests); the default runs until interrupted.  ``--json``
 polls ONCE and emits the whole snapshot — per-node status, mesh-collective
-and loadgen (``hbbft_load_*``) totals, cluster phase quantiles — as one
-JSON document for scripts to consume.
+and loadgen (``hbbft_load_*``) totals, gateway tier, cluster phase
+quantiles — as one JSON document for scripts to consume.
 """
 
 from __future__ import annotations
@@ -95,8 +101,43 @@ def phase_quantiles(snaps: List[Optional[dict]],
     return out
 
 
+def render_gateways(gw_targets: List[Target],
+                    gw_cur: List[Optional[dict]]) -> List[str]:
+    """The gateway-tier table (empty list when no gateways polled)."""
+    if not gw_targets:
+        return []
+    lines = [
+        "",
+        f"{'gateway':<22} {'id':>4} {'clients':>7} {'pending':>7} "
+        f"{'fwdq':>5} {'links':>5} {'fwd':>8} {'commits':>8} "
+        f"{'sheds':>6} {'failover':>8} {'drops':>6}",
+    ]
+    for i, (host, port) in enumerate(gw_targets):
+        snap = gw_cur[i]
+        name = f"{host}:{port}"
+        if snap is None:
+            lines.append(f"{name:<22} DOWN")
+            continue
+        d = snap["status"]
+        links = d.get("links") or []
+        live = sum(1 for li in links if li.get("connected"))
+        drops = metric_total(snap, "hbbft_gw_client_drops_total")
+        lines.append(
+            f"{name:<22} {d.get('gateway', '?'):>4} "
+            f"{d.get('clients', 0):>7} {d.get('pending', 0):>7} "
+            f"{d.get('forward_queue', 0):>5} "
+            f"{f'{live}/{len(links)}':>5} {d.get('forwarded', 0):>8} "
+            f"{d.get('commits_relayed', 0):>8} {d.get('sheds', 0):>6} "
+            f"{d.get('link_failovers', 0):>8} "
+            f"{'-' if drops is None else int(drops):>6}"
+        )
+    return lines
+
+
 def render(targets: List[Target], prev: List[Optional[dict]],
-           cur: List[Optional[dict]], dt: float) -> str:
+           cur: List[Optional[dict]], dt: float,
+           gw_targets: List[Target] = (),
+           gw_cur: List[Optional[dict]] = ()) -> str:
     lines: List[str] = []
     lines.append(
         f"hbbft-tpu obs.top — {len(targets)} nodes — "
@@ -156,6 +197,7 @@ def render(targets: List[Target], prev: List[Optional[dict]],
             f"{jrnl:>7} {jseg:>4} {jwf:>4} {_i(mesh):>6} "
             f"{_i(load):>8} {_i(shed):>5}"
         )
+    lines.extend(render_gateways(list(gw_targets), list(gw_cur)))
     pq = phase_quantiles(cur)
     lines.append("")
     lines.append(f"{'phase':<18} {'p50 ms':>9} {'p99 ms':>9}")
@@ -170,7 +212,9 @@ def render(targets: List[Target], prev: List[Optional[dict]],
 
 
 def snapshot_doc(targets: List[Target],
-                 cur: List[Optional[dict]]) -> dict:
+                 cur: List[Optional[dict]],
+                 gw_targets: List[Target] = (),
+                 gw_cur: List[Optional[dict]] = ()) -> dict:
     """One-shot machine-readable snapshot (``--json``)."""
     nodes = []
     for i, (host, port) in enumerate(targets):
@@ -192,14 +236,30 @@ def snapshot_doc(targets: List[Target],
                           "shed_txs", "committed_txs")
             },
         })
+    gateways = []
+    for i, (host, port) in enumerate(gw_targets):
+        snap = gw_cur[i]
+        if snap is None:
+            gateways.append({"target": f"{host}:{port}", "up": False})
+            continue
+        drops = metric_total(snap, "hbbft_gw_client_drops_total")
+        gateways.append({
+            "target": f"{host}:{port}",
+            "up": True,
+            "status": snap["status"],
+            "client_drops": None if drops is None else int(drops),
+        })
     pq = phase_quantiles(cur)
-    return {
+    doc = {
         "nodes": nodes,
         "phase_quantiles_ms": {
             ph: {"p50": v[0] * 1e3, "p99": v[1] * 1e3}
             for ph, v in sorted(pq.items())
         },
     }
+    if gateways:
+        doc["gateways"] = gateways
+    return doc
 
 
 def parse_targets(args) -> List[Target]:
@@ -222,6 +282,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--base-port", type=int, default=0,
                     help="metrics base port (node i at base+i)")
     ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--gateways", default="",
+                    help="comma-separated host:port gateway obs "
+                         "endpoints (gateway --metrics-port)")
     ap.add_argument("--interval", type=float, default=1.0)
     ap.add_argument("--iterations", type=int, default=0,
                     help="0 = run until interrupted; 1 = one snapshot")
@@ -229,12 +292,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="poll once, print a JSON snapshot, exit")
     args = ap.parse_args(argv)
     targets = parse_targets(args)
+    gw_targets: List[Target] = []
+    for part in args.gateways.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        gw_targets.append((host or "127.0.0.1", int(port)))
 
     if args.json:
         import json
 
         cur = [poll_target(h, p) for h, p in targets]
-        print(json.dumps(snapshot_doc(targets, cur), sort_keys=True))
+        gw_cur = [poll_target(h, p) for h, p in gw_targets]
+        print(json.dumps(
+            snapshot_doc(targets, cur, gw_targets, gw_cur),
+            sort_keys=True))
         return 0 if any(s is not None for s in cur) else 1
 
     clear = (sys.stdout.isatty() and args.iterations != 1)
@@ -244,8 +317,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         while True:
             cur = [poll_target(h, p) for h, p in targets]
+            gw_cur = [poll_target(h, p) for h, p in gw_targets]
             now = time.monotonic()
-            frame = render(targets, prev, cur, now - t_prev)
+            frame = render(targets, prev, cur, now - t_prev,
+                           gw_targets, gw_cur)
             if clear:
                 sys.stdout.write("\x1b[H\x1b[2J")
             print(frame, flush=True)

@@ -243,6 +243,7 @@ def test_fused_decrypt_mutation_parity(keys):
     path must agree EXACTLY — same plaintexts when accepted, rejection
     (ValueError) on the same inputs.  Guards the duplicated accept-set
     logic (flag/canonical/on-curve/subgroup/framing) against drift."""
+    pytest.importorskip("hypothesis")
     from hypothesis import HealthCheck, given, settings
     from hypothesis import strategies as st
 

@@ -174,7 +174,7 @@ def test_write_failures_are_counted_not_raised(tmp_path):
     assert reg.get("hbbft_obs_flight_write_failures_total").value() >= 2
     # the in-memory tail still has the records (the /flight endpoint
     # keeps working even when the disk does not)
-    assert any(t["type"] == "FlightCommit" for t in rec.tail)
+    assert any(type(t).__name__ == "FlightCommit" for t in rec.tail)
 
 
 def test_tail_jsonl_summarizes_payloads(tmp_path):

@@ -8,7 +8,9 @@ SURVEY §5 ("race detection"): same seed ⇒ bit-identical full message trace.
 import random
 
 import pytest
-from hypothesis import given, settings, strategies as st
+
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from hbbft_tpu.netinfo import NetworkInfo
 from hbbft_tpu.protocols import wire
