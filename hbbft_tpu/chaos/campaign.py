@@ -106,11 +106,14 @@ class CellSpec:
     kind: str = "sim"            # "sim" | "churn" | "socket"
     restarts: int = 2            # churn cells: kill/restart count
     pipeline_depth: int = 1      # socket cells: epochs kept in flight
+    vid: bool = False            # socket cells: order VID commitments,
+    #                              retrieve payloads lazily (net/retrieve)
 
     @property
     def name(self) -> str:
         return (f"{self.kind}--{self.shape}--{self.adversary}"
-                f"--n{self.n}--s{self.seed}")
+                f"--n{self.n}--s{self.seed}"
+                + ("--vid" if self.vid else ""))
 
     @property
     def faulty(self) -> Tuple[int, ...]:
@@ -507,6 +510,7 @@ async def _socket_scenario(spec: CellSpec, cell_dir: str
         heartbeat_s=0.3, dead_after_s=3.0,
         flight_dir=cell_dir,
         pipeline_depth=spec.pipeline_depth,
+        vid=spec.vid,
         chaos=spec.shape if spec.shape != "none" else "",
         chaos_seed=spec.seed,
         # flood cells tighten the ingress budgets so the guard engages
@@ -768,6 +772,16 @@ def full_grid(seeds: Sequence[int] = (0, 1),
         specs.append(CellSpec(kind="socket", shape="none",
                               adversary=adv, n=4, seed=0,
                               pipeline_depth=2))
+    # bandwidth-asymmetry comparison cells (VID tentpole): one straggler
+    # at 64 KB/s, classic RBC vs VID commitment ordering on the SAME
+    # shape and seed, pipeline_depth=1 so the comparison is apples to
+    # apples — classic serializes full payloads on the victim's uplink,
+    # VID ships it an O(1/n) shard and must stay live AND audit clean
+    # (cert-vs-retrieval corroboration included)
+    for vid in (False, True):
+        specs.append(CellSpec(kind="socket", shape="bandwidth-asym",
+                              adversary="null", n=4, seed=0,
+                              pipeline_depth=1, vid=vid))
     # socket identity-spoof cells (authenticated transport, end to
     # end): a raw-socket injector claims a correct validator's id
     # WITHOUT its key, in each refusal mode — every hello must die at

@@ -183,7 +183,15 @@ def preset_shape(name: str, n: int) -> NetShape:
     partition-10s   node n−1 partitioned from everyone for t ∈ [2 s, 12 s),
                     frames held and delivered at the heal
     bandwidth-64k   every link capped at 64 kbit/s (serialization queue)
+    bandwidth-asym  node n−1's links (both directions) capped at 64 KB/s,
+                    every other link unshaped — the DispersedLedger WAN
+                    shape: classic RBC collapses to the slow node's
+                    uplink, VID keeps ordering at the fast nodes' pace
     ==============  ========================================================
+
+    ``bandwidth-asym`` is deliberately NOT in :data:`PRESETS` (the full
+    campaign grid): it exists for the targeted classic-vs-VID comparison
+    cells and the ``BENCH_VID`` artifact, not for every adversary sweep.
     """
     if name in ("none", ""):
         return NetShape()
@@ -204,8 +212,17 @@ def preset_shape(name: str, n: int) -> NetShape:
     if name == "bandwidth-64k":
         return NetShape(default=ShapedLink(delay_s=0.002,
                                            bandwidth_bps=64_000.0))
+    if name == "bandwidth-asym":
+        # one straggler at 64 KB/s (= 524288 bit/s) in BOTH directions,
+        # everyone else unshaped — the shape under which payload-carrying
+        # broadcast (classic RBC) serializes on the victim's uplink while
+        # dispersal ships it only an O(1/n) shard
+        return _isolate(n, n - 1,
+                        ShapedLink(delay_s=0.002,
+                                   bandwidth_bps=8.0 * 64 * 1024))
     raise ValueError(
-        f"unknown chaos preset {name!r} (known: {', '.join(PRESETS)})")
+        f"unknown chaos preset {name!r} "
+        f"(known: {', '.join(PRESETS)}, bandwidth-asym)")
 
 
 PRESETS: Tuple[str, ...] = ("none", "wan-100ms", "lossy-1pct",
@@ -271,6 +288,17 @@ class LinkShaper:
 
     def policy_for(self, src: NodeId, dst: NodeId) -> Optional[LinkPolicy]:
         return self.shape.policy_for(src, dst)
+
+    def backlog_s(self, src: NodeId, dst: NodeId, now: float) -> float:
+        """Seconds of bulk already committed to the ``src → dst`` edge's
+        serialization queue (0.0 for unshaped / non-bandwidth edges).
+        The transport consults this before pushing more best-effort bulk
+        — e.g. VID dispersal shards beyond the cert's ``n − f`` voters —
+        at a peer whose link is already the bottleneck."""
+        state = self._state.get((src, dst))
+        if not state:
+            return 0.0
+        return max(0.0, state.get("bw_clear", 0.0) - now)
 
     def rng_for(self, src: NodeId, dst: NodeId) -> random.Random:
         edge = (src, dst)

@@ -72,6 +72,23 @@ class FaultKind(enum.Enum):
     EchoHashConflict = "broadcast: EchoHash conflicts with a full Echo"
     # (EchoHashConflict is raised by broadcast when a node's hash-only echo
     # evidence names a different root than its full Echo)
+    # verifiable information dispersal
+    VidInvalidDisperse = (
+        "vid: Disperse carried an invalid or misdirected Merkle proof"
+    )
+    VidInvalidVote = "vid: availability vote with an invalid signature"
+    VidInvalidCert = (
+        "vid: committed contribution carried an invalid retrievability "
+        "certificate"
+    )
+    VidShardProofInvalid = (
+        "vid: retrieved shard failed its Merkle proof (counted; "
+        "reconstruction proceeds from other donors)"
+    )
+    VidReconstructMismatch = (
+        "vid: reconstructed shards do not re-root to the committed "
+        "commitment (non-codeword dispersal — proposer fault)"
+    )
 
 
 def equivocation_kinds() -> frozenset:

@@ -130,9 +130,14 @@ class DeterminismChecker(Checker):
     # stage records ride the wire (tag 0x95) and must be derivable from
     # the tx bytes alone — a clock or RNG read here would fork the
     # byte-identical critpath reports of identical-seed sim runs
+    # net/retrieve.py is clock-FREE by contract: every deadline decision
+    # takes `now` from the caller (the runtime's pump), so the retrieval
+    # state machine replays deterministically under the simulator — a
+    # wall-clock read inside it would break that
     scope = ("hbbft_tpu/protocols/", "hbbft_tpu/parallel/",
              "hbbft_tpu/crypto/", "hbbft_tpu/chaos/",
-             "hbbft_tpu/ops/rs.py", "hbbft_tpu/obs/trace.py")
+             "hbbft_tpu/ops/rs.py", "hbbft_tpu/obs/trace.py",
+             "hbbft_tpu/net/retrieve.py")
     rules = {
         "det-wall-clock":
             "wall-clock read in consensus-core code (time.time, "

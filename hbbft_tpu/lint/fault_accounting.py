@@ -112,7 +112,11 @@ class FaultAccountingChecker(Checker):
     #: flight recorder's journal-durability paths, and the chaos layer
     #: (a shaped/dropped frame the campaign can't account for would
     #: corrupt every liveness number the report emits)
-    DROP_SCOPE = ("hbbft_tpu/net/", "hbbft_tpu/obs/", "hbbft_tpu/chaos/")
+    #: protocols/vid.py joins net/'s drop scope: a swallowed disperse /
+    #: vote / cert failure is availability input dropped without the
+    #: counted fault the retrievability argument depends on
+    DROP_SCOPE = ("hbbft_tpu/net/", "hbbft_tpu/obs/", "hbbft_tpu/chaos/",
+                  "hbbft_tpu/protocols/vid.py")
 
     def check_module(self, mod: ModuleSource) -> Iterable[Finding]:
         tree = mod.tree

@@ -5,7 +5,7 @@ The PR-3 static pass, rehosted on the lint framework (the repo-root
 output).  Three invariants over the package + ``bench.py``:
 
 - every registered metric name follows
-  ``hbbft_<net|node|phase|sim|obs|chaos|sync|guard|rbc|load|mesh|gw>_<name>``;
+  ``hbbft_<net|node|phase|sim|obs|chaos|sync|guard|rbc|load|mesh|gw|vid>_<name>``;
 - every registered metric name is documented in README.md's Observability
   section;
 - every :class:`~hbbft_tpu.fault_log.FaultKind` variant has a
@@ -25,7 +25,7 @@ from hbbft_tpu.lint.core import Checker, Finding, Project, register
 
 NAME_CONVENTION = re.compile(
     r"^hbbft_(net|node|phase|sim|obs|chaos|sync|guard|rbc|load|mesh"
-    r"|pump|trace|gw)"
+    r"|pump|trace|gw|vid)"
     r"_[a-z][a-z0-9_]*$"
 )
 

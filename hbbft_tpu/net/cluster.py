@@ -57,6 +57,12 @@ class ClusterConfig:
     # of small txs, or 64 KB txs) size this to the tx they carry
     max_tx_bytes: int = 0
     encrypt: bool = False       # TPKE-encrypt contributions
+    # verifiable information dispersal (protocols/vid.py): propose
+    # constant-size (root, cert) commitments and retrieve payloads
+    # lazily post-commit, instead of reliable-broadcasting every full
+    # contribution through the epoch — the WAN-asymmetry mode where one
+    # bandwidth-starved node no longer drags every commit
+    vid: bool = False
     heartbeat_s: float = 0.5
     dead_after_s: float = 3.0
     replay_retain_epochs: int = 64
@@ -212,8 +218,12 @@ class ClusterConfig:
 
     @property
     def cluster_id(self) -> bytes:
+        # VID and classic clusters must never cross-connect (their batch
+        # flavors hash differently); non-VID ids stay byte-identical
+        # with earlier releases
         return b"hbbft-net/%d/%d/%d" % (self.n, self.seed,
-                                        1 if self.encrypt else 0)
+                                        1 if self.encrypt else 0) + (
+            b"/vid" if self.vid else b"")
 
     def addr(self, nid: int) -> Addr:
         if self.base_port == 0:
@@ -294,10 +304,18 @@ def build_algo(cfg: ClusterConfig, infos: Dict[int, NetworkInfo],
             else EncryptionSchedule.never()
         ),
     )
-    qhb = QueueingHoneyBadger(
-        dhb, batch_size=cfg.batch_size,
-        rng=random.Random(cfg.seed * 100_000 + 8000 + nid),
-    )
+    if cfg.vid:
+        from hbbft_tpu.protocols.vid import VidQueueingHoneyBadger
+
+        qhb = VidQueueingHoneyBadger(
+            dhb, batch_size=cfg.batch_size,
+            rng=random.Random(cfg.seed * 100_000 + 8000 + nid),
+        )
+    else:
+        qhb = QueueingHoneyBadger(
+            dhb, batch_size=cfg.batch_size,
+            rng=random.Random(cfg.seed * 100_000 + 8000 + nid),
+        )
     return SenderQueue(qhb)
 
 
@@ -647,6 +665,8 @@ def node_command(cfg: ClusterConfig, nid: int) -> List[str]:
         cmd += ["--flight-dir", cfg.flight_dir]
     if cfg.encrypt:
         cmd.append("--encrypt")
+    if cfg.vid:
+        cmd.append("--vid")
     if cfg.pipeline_depth != 1:
         cmd += ["--pipeline-depth", str(cfg.pipeline_depth)]
     if cfg.link_delays:
@@ -882,6 +902,10 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="per-tx admission ceiling in bytes "
                          "(0 = Mempool default, 256 KiB)")
     ap.add_argument("--encrypt", action="store_true")
+    ap.add_argument("--vid", action="store_true",
+                    help="verifiable information dispersal: order "
+                         "constant-size (root, cert) commitments and "
+                         "retrieve payloads lazily post-commit")
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="serve /metrics /status /spans /flight on this "
                          "port (0 = off)")
@@ -940,7 +964,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     cfg = ClusterConfig(
         n=args.nodes, seed=args.seed, base_port=args.base_port,
         batch_size=args.batch_size, max_tx_bytes=args.max_tx_bytes,
-        encrypt=args.encrypt,
+        encrypt=args.encrypt, vid=args.vid,
         flight_dir=args.flight_dir, pipeline_depth=args.pipeline_depth,
         link_delays=args.link_delays,
         chaos=args.chaos, chaos_seed=args.chaos_seed,

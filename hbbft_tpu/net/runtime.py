@@ -45,11 +45,15 @@ import logging
 import os
 import struct
 import time
-from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
+from collections import OrderedDict
+from typing import (
+    Any, Callable, Dict, FrozenSet, Hashable, List, Optional, Tuple,
+)
 
 from hbbft_tpu.net import framing
 from hbbft_tpu.net.client import Mempool, tx_digest
 from hbbft_tpu.net.degrade import attach_runtime as _attach_degrade
+from hbbft_tpu.net.retrieve import RetrieveService, RetrievedPayload
 from hbbft_tpu.net.scheduler import StepPump
 from hbbft_tpu.net.statesync import SnapshotStore
 from hbbft_tpu.net.transport import ClientConn, EraKeyRing, Transport
@@ -67,10 +71,21 @@ from hbbft_tpu.protocols.dynamic_honey_badger import (
     DynamicHoneyBadger,
 )
 from hbbft_tpu.protocols.honey_badger import Batch as HbBatch, HoneyBadger
+from hbbft_tpu.fault_log import FaultKind
 from hbbft_tpu.protocols.queueing_honey_badger import (
     PipelineInput,
     QhbBatch,
     TxInput,
+    _de_txs,
+)
+from hbbft_tpu.protocols.vid import (
+    VidCertReady,
+    VidDisperse,
+    VidQhbBatch,
+    VidQueueingHoneyBadger,
+    VidRetrieve,
+    VidShard,
+    payload_digest,
 )
 from hbbft_tpu.protocols.sender_queue import (
     AlgoMessage,
@@ -112,6 +127,35 @@ Addr = Tuple[str, int]
 logger = logging.getLogger("hbbft_tpu.net")
 
 
+def pick_shed_peers(
+    backlogs: Dict[Any, float],
+    threshold_s: float,
+    max_shed: int,
+    already: FrozenSet[Any] = frozenset(),
+) -> FrozenSet[Any]:
+    """Which peers a VID proposer may skip when fanning out this root's
+    dispersal shards.
+
+    A dispersal beyond the cert's ``n − f`` voters is pure availability
+    insurance, so frames bound for a congested link are the one place the
+    protocol can legally shed load: the skipped peer retrieves the
+    payload lazily after ordering the commitment.  Classic RBC has no
+    such slack — every payload frame is on the ordering critical path.
+
+    The root's prior shed set is reused (and extended, within budget) so
+    a re-dispersal of the same root never exceeds ``max_shed`` distinct
+    peers total; worst links are shed first."""
+    shed = set(already)
+    for peer, lag in sorted(backlogs.items(), key=lambda kv: (-kv[1],
+                                                              repr(kv[0]))):
+        if len(shed) >= max_shed:
+            break
+        if peer in shed or lag < threshold_s:
+            continue
+        shed.add(peer)
+    return frozenset(shed)
+
+
 class NodeRuntime:
     """One networked consensus node: SenderQueue-wrapped algorithm +
     :class:`Transport` + client admission."""
@@ -148,9 +192,40 @@ class NodeRuntime:
         auth_grace_s: float = 30.0,
         degrade: bool = True,
         degrade_kwargs: Optional[Dict[str, Any]] = None,
+        vid_retrieve_kwargs: Optional[Dict[str, Any]] = None,
+        vid_shed_backlog_s: float = 0.25,
         **transport_kwargs,
     ):
         self.sq = algo if isinstance(algo, SenderQueue) else SenderQueue(algo)
+        # VID mode (protocols/vid.py + net/retrieve.py): the wrapped
+        # algorithm orders constant-size (root, cert) commitments and the
+        # runtime owns lazy payload retrieval — fetch k shards, rebuild,
+        # re-verify — off the ordering critical path.
+        self._vid = isinstance(self.sq.algo, VidQueueingHoneyBadger)
+        self._retrieve: Optional[RetrieveService] = None
+        # root → (era, epoch) of the committed commitment awaiting its
+        # payload; resolved entries are popped in _on_retrieved
+        self._vid_pending: Dict[bytes, EpochKey] = {}
+        if self._vid:
+            self._retrieve = RetrieveService(
+                self.sq.our_id(), self.sq.algo.store,
+                on_note=self._vid_note,
+                **(vid_retrieve_kwargs or {}))
+        # Best-effort dispersal shedding: a shard bound for a peer whose
+        # shaped link already has ≥ this many seconds of bulk committed
+        # is dropped at dispatch (at most f peers per root, so the cert
+        # stays reachable from the remaining n − f voters).  0 disables.
+        # Keep the threshold SMALL: shards admitted while the backlog sits
+        # just under it become a standing serialization queue that every
+        # consensus control frame behind them must wait out — the
+        # threshold is effectively the straggler's added ordering latency.
+        self.vid_shed_backlog_s = float(vid_shed_backlog_s)
+        # root → frozen shed set, LRU-capped: a re-dispersal of the same
+        # root (excluded proposer re-sampling its queue) reuses the same
+        # budget instead of shedding a fresh f peers each time
+        self._vid_shed_roots: "OrderedDict[bytes, FrozenSet[Any]]" = (
+            OrderedDict())
+        self._vid_sheds = 0
         # Epoch-pipelined scheduler (net/scheduler.py): every protocol
         # interaction is queued and processed in batches on the pump's
         # worker thread; with pipeline_depth > 1 the pump keeps that many
@@ -179,10 +254,12 @@ class NodeRuntime:
             c.strip() for c in aba_out_classes.split(",") if c.strip()
         )
         # tick_s: the degradation controller needs periodic pump wakes
-        # to recover on an idle node (see StepPump); without the
-        # controller the pump stays purely event-driven
+        # to recover on an idle node (see StepPump), and VID retrieval
+        # retries need the same heartbeat; without either the pump stays
+        # purely event-driven
         self.pump = StepPump(self, pipeline_depth=self.pipeline_depth,
-                             tick_s=0.25 if degrade else None)
+                             tick_s=0.25 if (degrade or self._vid)
+                             else None)
         self._out: Optional[_PumpOutcome] = None
         # park threshold-decrypt share verification in the protocols so
         # the pump can resolve ALL in-flight epochs' sets in one merged
@@ -259,6 +336,26 @@ class NodeRuntime:
             self._c_mesh_coll.labels(phase=ph)
             self._c_mesh_bytes.labels(phase=ph)
         self._mesh_stats_last = _mesh.stats_snapshot()
+        # hbbft_vid_*: dispersal/retrieval accounting.  The protocol and
+        # service layers keep deterministic plain-int counters (both are
+        # in hblint's determinism scope); scrapes fold the deltas here,
+        # same pattern as the rs/mesh counters above.
+        self._c_vid = None
+        self._vid_stats_last: Dict[str, int] = {}
+        if self._vid:
+            self._c_vid = self.registry.counter(
+                "hbbft_vid_events_total",
+                "verifiable-information-dispersal events by kind "
+                "(disperse / vote_cast / cert = proposer+voter side; "
+                "retrieve / retrieved / retry / failure = requester "
+                "side; shard_served / refusal / quota_drop = donor "
+                "side; disperse_shed = best-effort dispersals skipped "
+                "toward backlogged links; bad_shard / mismatch = "
+                "Byzantine evidence; stray_shard / store_eviction = "
+                "hygiene)",
+                labelnames=("kind",), max_label_sets=16)
+            for k in self._vid_stats():
+                self._c_vid.labels(kind=k)
         self.registry.register_callback(self._refresh_gauges)
         # `is not None`, not `or`: Mempool defines __len__, so a freshly
         # configured (empty → falsy) instance would be silently replaced
@@ -269,8 +366,12 @@ class NodeRuntime:
         # not a config escape hatch: a proposal of batch_size max-size txs
         # must fit the wire blob cap with margin (TLV + TPKE overhead),
         # or an honest proposer could wedge its own epochs
+        # (in VID mode proposals are constant-size commitments — the
+        # payload travels as per-node shards of ~1/k its size — so
+        # MB-scale batch shapes the classic check forbids are exactly
+        # the point; the shard frames stay under the cap by design)
         batch_size = getattr(self.sq.algo, "batch_size", None)
-        if batch_size is not None:
+        if batch_size is not None and not self._vid:
             worst = batch_size * (self.mempool.max_tx_bytes + 16)
             if worst > wire.MAX_BLOB_BYTES // 2:
                 raise ValueError(
@@ -513,9 +614,67 @@ class NodeRuntime:
     def pump_tick(self) -> None:
         """Periodic pump heartbeat (between iterations, serialized with
         pump_process): drives the degradation controller so engage AND
-        recovery both proceed whether the node is busy or idle."""
+        recovery both proceed whether the node is busy or idle.  VID
+        retrieval retries are enqueued as a pump event rather than run
+        here — the tick has no _PumpOutcome to absorb Steps into."""
         if self.degrade is not None:
             self.degrade.tick()
+        if self._retrieve is not None and self._retrieve.pending_count():
+            deadline = self._retrieve.next_deadline()
+            if deadline is not None and time.time() >= deadline:
+                self.pump.enqueue("vid_tick")
+
+    def _vid_note(self, kind: str, detail: str) -> None:
+        """RetrieveService loudness sink → flight journal (the service's
+        methods only ever run on the pump thread, where appends are
+        allowed)."""
+        if self.flight is not None:
+            self.flight.on_note(kind, detail)
+
+    def _shed_for_disperse(
+        self, root: bytes, peer_ids: List[NodeId]
+    ) -> "FrozenSet[Any]":
+        """The (possibly empty) set of peers to skip for this root's
+        dispersal frames — see :func:`pick_shed_peers` for the policy.
+        Budget is ``f``: with our own vote plus the other ``n − 1 − f``
+        peers still served, the ``n − f`` cert threshold stays reachable
+        even if every shed peer never sees the shard."""
+        f = len(peer_ids) // 3  # n = peers + 1, so f = (n − 1) // 3
+        if f <= 0:
+            return frozenset()
+        roots = self._vid_shed_roots
+        already = roots.get(root, frozenset())
+        backlogs = {p: self.transport.send_backlog_s(p) for p in peer_ids}
+        shed = pick_shed_peers(
+            backlogs, self.vid_shed_backlog_s, f, already)
+        roots[root] = shed
+        roots.move_to_end(root)
+        while len(roots) > 64:
+            roots.popitem(last=False)
+        return shed
+
+    def _vid_stats(self) -> Dict[str, int]:
+        """The VID layers' deterministic plain-int counters, keyed by
+        the ``hbbft_vid_events_total`` kind label."""
+        d = self.sq.algo.disperser
+        s = self._retrieve
+        return {
+            "disperse": d.disperses,
+            "vote_cast": d.votes_cast,
+            "cert": d.certs,
+            "retrieve": s.retrieves,
+            "retrieved": s.retrieved,
+            "shard_served": s.served,
+            "refusal": s.refusals,
+            "quota_drop": s.quota_drops,
+            "bad_shard": s.shards_bad,
+            "mismatch": s.mismatches,
+            "retry": s.retries,
+            "failure": s.failures,
+            "stray_shard": s.stray_shards,
+            "store_eviction": self.sq.algo.store.evictions,
+            "disperse_shed": self._vid_sheds,
+        }
 
     # -- observability -------------------------------------------------------
     #
@@ -572,6 +731,19 @@ class NodeRuntime:
             if d_bytes > 0:
                 self._c_mesh_bytes.labels(phase=ph).inc(d_bytes)
             self._mesh_stats_last[ph] = dict(cur)
+        if self._c_vid is not None:
+            cur = self._vid_stats()
+            for k, v in cur.items():
+                delta = v - self._vid_stats_last.get(k, 0)
+                if delta > 0:
+                    self._c_vid.labels(kind=k).inc(delta)
+            self._vid_stats_last = cur
+            r.gauge("hbbft_vid_store_bytes",
+                    "bytes held by the bounded LRU shard store").set(
+                        self.sq.algo.store.bytes)
+            r.gauge("hbbft_vid_pending_retrievals",
+                    "committed commitments whose payload retrieval is "
+                    "still in flight").set(self._retrieve.pending_count())
         era, epoch = self.current_key()
         r.gauge("hbbft_node_era", "current consensus era").set(era)
         r.gauge("hbbft_node_epoch", "current epoch within the era").set(epoch)
@@ -837,6 +1009,8 @@ class NodeRuntime:
                         self._process_guard_event(*args)
                     elif kind == "shed":
                         self._process_shed(args[0])
+                    elif kind == "vid_tick":
+                        self._absorb(self._retrieve.tick(time.time()))
                     else:  # pragma: no cover - enqueue() callers are local
                         raise ValueError(f"unknown pump event {kind!r}")
                     segs[kind] = segs.get(kind, 0.0) + (pc() - t0)
@@ -906,6 +1080,8 @@ class NodeRuntime:
                 self._process_guard_event(*args)
             elif kind == "shed":
                 self._process_shed(args[0])
+            elif kind == "vid_tick":
+                self._absorb(self._retrieve.tick(time.time()))
             else:  # pragma: no cover - enqueue() callers are local
                 raise ValueError(f"unknown pump event {kind!r}")
             timing[kind] = timing.get(kind, 0.0) + (tt() - t0)
@@ -1053,6 +1229,16 @@ class NodeRuntime:
                 cache.clear()
             cache[payload] = msg
         if not isinstance(msg, (AlgoMessage, EpochStarted)):
+            # runtime-level VID retrieval traffic rides the same sockets
+            # but never enters the SenderQueue: route it to the retrieve
+            # service (whose Steps absorb exactly like protocol steps)
+            if self._retrieve is not None and isinstance(
+                    msg, (VidRetrieve, VidShard)):
+                if self.flight is not None:
+                    self.flight.on_message(peer_id, msg,
+                                           payload=bytes(payload))
+                self._process_vid_direct(peer_id, msg)
+                return
             self.decode_failures += 1
             self.transport.ingress.decode_strike(peer_id)
             logger.warning("non-sender-queue message %s from %r",
@@ -1130,6 +1316,15 @@ class NodeRuntime:
                 elif timing is not None:
                     timing["n_dec_hit"] = timing.get("n_dec_hit", 0) + 1
             if not isinstance(msg, (AlgoMessage, EpochStarted)):
+                if self._retrieve is not None and isinstance(
+                        msg, (VidRetrieve, VidShard)):
+                    # handled inline: retrieval traffic is ordering-
+                    # independent of the consensus messages around it
+                    if self.flight is not None:
+                        self.flight.on_message(peer_id, msg,
+                                               payload=payload)
+                    self._process_vid_direct(peer_id, msg)
+                    continue
                 self.decode_failures += 1
                 strike(peer_id)
                 logger.warning("non-sender-queue message %s from %r",
@@ -1230,8 +1425,19 @@ class NodeRuntime:
             if self.flight is not None:
                 self.flight.on_step(step)
             for out in step.output:
-                if isinstance(out, (QhbBatch, DhbBatch, HbBatch)):
+                if isinstance(out, (QhbBatch, DhbBatch, HbBatch,
+                                    VidQhbBatch)):
                     self._on_batch(out)
+                elif isinstance(out, VidCertReady):
+                    # proposer-side audit anchor: every retriever's
+                    # vid_retrieved note must corroborate this digest
+                    if self.flight is not None:
+                        self.flight.on_note(
+                            "vid_cert",
+                            f"root={out.root.hex()} len={out.total_len} "
+                            f"payload_sha3={out.payload_sha3}")
+                elif isinstance(out, RetrievedPayload):
+                    self._on_retrieved(out)
             self._dispatch(step)
         except Exception as exc:
             # fatal in the consensus path: flush the black box so the
@@ -1274,6 +1480,11 @@ class NodeRuntime:
                 message_key(tm.message.msg)
                 if isinstance(tm.message, AlgoMessage) else None
             )
+            shed: FrozenSet[Any] = frozenset()
+            if (self._vid and self.vid_shed_backlog_s > 0
+                    and isinstance(msg, AlgoMessage)
+                    and type(msg.msg) is VidDisperse):
+                shed = self._shed_for_disperse(msg.msg.root, peer_ids)
             frames = out.frames
             if self.aba_out_delay_s > 0 and key is not None:
                 from hbbft_tpu.obs.spans import classify
@@ -1285,6 +1496,15 @@ class NodeRuntime:
                 ):
                     frames = out.frames_delayed
             for dest in tm.target.resolve(all_ids, our):
+                if dest in shed and dest != our:
+                    # skip replay registration too: a reconnect replay
+                    # pushing the shard would defeat the shed entirely
+                    self._vid_sheds += 1
+                    if self.flight is not None:
+                        self.flight.on_note(
+                            "vid_shed",
+                            f"root={msg.msg.root.hex()} peer={dest!r}")
+                    continue
                 frames.setdefault(dest, []).append(payload)
                 if key is not None:
                     dedup = (key, payload)
@@ -1393,8 +1613,111 @@ class NodeRuntime:
             # client sockets are event-loop objects: the notification is
             # queued on the outcome and written by pump_flush
             self._out.commits.append((batch.era, batch.epoch, digests))
+        elif isinstance(batch, VidQhbBatch):
+            self._on_vid_batch(batch)
         if self.on_batch is not None:
             self.on_batch(batch)
+
+    # -- VID resolution (pump thread) ----------------------------------------
+
+    def _process_vid_direct(self, peer_id: NodeId, msg: Any) -> None:
+        """Route runtime-level retrieval traffic (pump thread)."""
+        now = time.time()
+        if isinstance(msg, VidRetrieve):
+            self._absorb(self._retrieve.handle_retrieve(peer_id, msg, now))
+        else:
+            self._absorb(self._retrieve.handle_shard(peer_id, msg, now))
+
+    def _on_vid_batch(self, batch: VidQhbBatch) -> None:
+        """An epoch ORDERED in VID mode: commit what resolves locally
+        (plain contributions, our own dispersals) right now, open a
+        retrieval for every foreign commitment.  ``commit`` is the
+        ordering instant; each contribution's ``commit_retrieved``
+        lands when its payload does — identical timestamps for the
+        locally-resolved part, so the two stages always bracket the
+        retrieval gap exactly."""
+        now = time.time()
+        vqhb = self.sq.algo
+        ni = vqhb.dhb.netinfo
+        txs: List[bytes] = []
+        for _proposer, plain in batch.plain_txs():
+            txs.extend(plain)
+        for proposer, cert in batch.commitments():
+            local = vqhb.disperser.local_payload(cert.root)
+            if local is not None:
+                txs.extend(_de_txs(local))
+                if self.flight is not None:
+                    self.flight.on_note(
+                        "vid_retrieved",
+                        f"root={cert.root.hex()} "
+                        f"payload_sha3={payload_digest(local)} "
+                        f"shards_bad=0 rounds=0")
+                continue
+            # _vid_pending first: start() can complete synchronously
+            # (our own stored shard suffices when k == 1) and the
+            # resulting RetrievedPayload resolves through _on_retrieved
+            self._vid_pending[cert.root] = (batch.era, batch.epoch)
+            # holders in shard-index order: node i stores shard i, so the
+            # retrieve service can target exactly the missing indices
+            holders = tuple(sorted(ni.all_ids(), key=ni.node_index))
+            self._absorb(self._retrieve.start(
+                cert.root, cert.total_len, ni.num_nodes(),
+                ni.num_faulty(), proposer, now, now, holders=holders))
+        if txs:
+            self._c_committed.inc(len(txs))
+            digests = self.mempool.mark_committed(txs)
+            self._out.commits.append((batch.era, batch.epoch, digests))
+            self._vid_traces(batch.era, batch.epoch, txs, now, now)
+
+    def _on_retrieved(self, rp: RetrievedPayload) -> None:
+        """A retrieval finished (pump thread): surface the audit note,
+        and on success commit the transactions against the ordering
+        position recorded at batch time."""
+        key = self._vid_pending.pop(rp.root, None)
+        if key is None:
+            return
+        era, epoch = key
+        now = time.time()
+        sha3 = (payload_digest(rp.payload)
+                if rp.payload is not None else "none")
+        if self.flight is not None:
+            self.flight.on_note(
+                "vid_retrieved",
+                f"root={rp.root.hex()} payload_sha3={sha3} "
+                f"shards_bad={rp.shards_bad} rounds={rp.rounds}")
+        if rp.payload is None:
+            # mismatch / exhaustion: the service already logged the
+            # fault evidence; the contribution resolves to nothing on
+            # every correct node identically
+            return
+        try:
+            txs = list(_de_txs(rp.payload))
+        except ValueError:
+            # a valid codeword of a non-contribution payload: the
+            # proposer certified garbage — same fault class as a plain
+            # contribution that fails to deserialize
+            self._absorb(Step.from_fault(
+                rp.proposer, FaultKind.BatchDeserializationFailed))
+            return
+        self.sq.algo.on_retrieved(txs)
+        self._c_committed.inc(len(txs))
+        digests = self.mempool.mark_committed(txs)
+        self._out.commits.append((era, epoch, digests))
+        self._vid_traces(era, epoch, txs, rp.t_ordered, now)
+
+    def _vid_traces(self, era: int, epoch: int, txs: List[bytes],
+                    t_ordered: float, t_resolved: float) -> None:
+        """Journal the commit / commit_retrieved stage pair: ``commit``
+        carries the ordering timestamp, ``commit_retrieved`` the moment
+        the payload became readable, so per-tx waterfalls report both
+        latencies and their difference is exactly the retrieval gap."""
+        if self.flight is None or not txs:
+            return
+        tids = b"".join(trace_id(bytes(tx)) for tx in txs)
+        self.flight.recorder.record_trace("commit", era, epoch, tids,
+                                          t=t_ordered)
+        self.flight.recorder.record_trace("commit_retrieved", era, epoch,
+                                          tids, t=t_resolved)
 
     def _notify_commit(self, era: int, epoch: int,
                        digests: List[bytes]) -> None:
@@ -1504,6 +1827,15 @@ class NodeRuntime:
             },
             "degraded": (self.degrade.as_dict()
                          if self.degrade is not None else None),
+            "vid": (
+                {
+                    "pending_retrievals": self._retrieve.pending_count(),
+                    "store_bytes": self.sq.algo.store.bytes,
+                    "store_roots": len(self.sq.algo.store),
+                    **self._vid_stats(),
+                }
+                if self._vid else None
+            ),
             "faults_observed": self.faults_observed,
             "peers_connected": sum(
                 1 for p in self.transport.peer_ids()

@@ -1456,6 +1456,18 @@ class Transport:
         sender = self._senders.get(peer_id)
         return 0 if sender is None else len(sender.outbox)
 
+    def send_backlog_s(self, peer_id: NodeId) -> float:
+        """Seconds of bulk already committed to the shaped link toward
+        ``peer_id``.  Shaped frames are delayed *before* they reach the
+        outbox (``_PeerSender.send`` defers them via ``call_later``), so
+        ``queued()`` never sees that backlog — the shaper's bandwidth
+        clock is the only honest congestion signal.  Returns 0.0 when no
+        shaper is attached (real deployments would read the socket send
+        buffer instead)."""
+        if self.shaper is None:
+            return 0.0
+        return self.shaper.backlog_s(self.our_id, peer_id, self.chaos_now())
+
     def send(self, peer_id: NodeId, payload: bytes) -> None:
         """Queue one consensus MSG frame for ``peer_id``."""
         self.send_frame(peer_id, framing.MSG, payload)

@@ -100,6 +100,19 @@ def _parse_statesync_note(detail: str) -> Optional[Dict[str, Any]]:
         return None
 
 
+def _parse_vid_note(detail: str) -> Optional[Dict[str, str]]:
+    """``root=HEX … payload_sha3=D`` → field dict (the runtime's VID
+    journal format: ``vid_cert`` notes from the proposer anchor the
+    payload digest behind a dispersed root; ``vid_retrieved`` notes from
+    every resolver must corroborate it)."""
+    fields = dict(
+        part.split("=", 1) for part in detail.split() if "=" in part
+    )
+    if "root" not in fields or "payload_sha3" not in fields:
+        return None
+    return fields
+
+
 def _digest(payload: bytes) -> str:
     return hashlib.sha3_256(payload).hexdigest()[:16]
 
@@ -228,6 +241,17 @@ class AuditResult:
     # sender never journaled sending — the tampering shape) still is.
     restart_reproposals: List[Dict[str, Any]] = field(
         default_factory=list)
+    # VID cert-vs-retrieval corroboration: every ``vid_retrieved`` note's
+    # payload digest must agree with the proposer's ``vid_cert`` anchor
+    # and with every other resolver of the same root.  Two digests behind
+    # one committed root is a content fork — the ordered commitment was
+    # unambiguous but nodes read different payloads through it.
+    # Uncorroborated roots (proposer journal rotated, no retrieval yet)
+    # are benign and merely counted.
+    vid_roots: int = 0
+    vid_corroborated: int = 0
+    vid_inconsistencies: List[Dict[str, Any]] = field(
+        default_factory=list)
     # resource-exhaustion forensics: journaled ``guard`` notes (ingress
     # throttle escalations, SenderQueue backlog evictions, hello rejects
     # — written by the runtime's overload defense) plus protocol-layer
@@ -244,7 +268,8 @@ class AuditResult:
     @property
     def verdict(self) -> str:
         if self.first_divergence or self.self_conflicts \
-                or self.status_mismatches or self.sync_mismatches:
+                or self.status_mismatches or self.sync_mismatches \
+                or self.vid_inconsistencies:
             return "fork"
         if self.equivocations or self.monotonicity_violations:
             return "fault"
@@ -273,6 +298,9 @@ class AuditResult:
             "sync_mismatches": self.sync_mismatches,
             "restart_reproposals": self.restart_reproposals,
             "overload_incidents": self.overload_incidents,
+            "vid_roots": self.vid_roots,
+            "vid_corroborated": self.vid_corroborated,
+            "vid_inconsistencies": self.vid_inconsistencies,
         }
 
 
@@ -303,6 +331,9 @@ def audit(journals: List[Journal]) -> AuditResult:
     commits: Dict[str, Dict[int, Tuple[str, int, int, int]]] = {}
     # overload[peer] = {"kinds": {kind: count}, "witnesses": set}
     overload: Dict[str, Dict[str, Any]] = {}
+    # vid[root] = {payload_sha3: {"cert:<node>" | "retr:<node>", ...}}
+    vid: Dict[str, Dict[str, set]] = {}
+    vid_anchored: set = set()  # roots with at least one vid_cert note
 
     def _overload_hit(peer: str, kind: str, witness: str,
                       claimed: Optional[str] = None) -> None:
@@ -426,6 +457,27 @@ def audit(journals: List[Journal]) -> AuditResult:
                     if hit is not None:
                         _overload_hit(hit["peer"], hit["kind"], node,
                                       hit.get("claimed"))
+                elif rec.kind in ("vid_cert", "vid_retrieved"):
+                    fields = _parse_vid_note(rec.detail)
+                    if fields is None:
+                        res.vid_inconsistencies.append({
+                            "root": "?",
+                            "error": f"malformed {rec.kind} note "
+                                     f"{rec.detail!r} @{node}#{inc}",
+                        })
+                        continue
+                    sha3 = fields["payload_sha3"]
+                    if sha3 == "none":
+                        # failed retrieval — already surfaced through
+                        # the vid_mismatch/vid_exhausted notes and the
+                        # proposer fault; no digest to corroborate
+                        continue
+                    tag = ("cert" if rec.kind == "vid_cert"
+                           else "retr")
+                    vid.setdefault(fields["root"], {}).setdefault(
+                        sha3, set()).add(f"{tag}:{node}")
+                    if rec.kind == "vid_cert":
+                        vid_anchored.add(fields["root"])
     res.events.sort(key=lambda e: (e.era, e.epoch, e.rank, e.key))
     # resource-exhaustion attribution: most-implicated peer first
     res.overload_incidents = [
@@ -445,6 +497,25 @@ def audit(journals: List[Journal]) -> AuditResult:
             key=lambda kv: (-sum(kv[1]["kinds"].values()), kv[0]),
         )
     ]
+
+    # -- VID cert-vs-retrieval consistency -----------------------------------
+    # One root, one payload: the proposer's vid_cert digest and every
+    # resolver's vid_retrieved digest must be THE same sha3.  A root only
+    # counts as corroborated when at least two independent accounts
+    # agree (cert + a retrieval, or two retrievals); a lone account is
+    # benign but proves nothing.
+    res.vid_roots = len(vid)
+    for root in sorted(vid):
+        digests = vid[root]
+        if len(digests) > 1:
+            res.vid_inconsistencies.append({
+                "root": root,
+                "anchored": root in vid_anchored,
+                "digests": {d: sorted(w)
+                            for d, w in sorted(digests.items())},
+            })
+        elif sum(len(w) for w in digests.values()) >= 2:
+            res.vid_corroborated += 1
 
     # -- digest-chain agreement ----------------------------------------------
     for node, per_index in commits.items():
@@ -629,6 +700,19 @@ def format_report(res: AuditResult, timeline: bool = False,
         kinds = " ".join(f"{k}×{n}" for k, n in o["kinds"].items())
         lines.append(f"OVERLOAD: peer {o['peer']} — {kinds} "
                      f"(witnessed by {', '.join(o['witnesses'])})")
+    if res.vid_roots:
+        lines.append(f"vid: {res.vid_roots} dispersed roots, "
+                     f"{res.vid_corroborated} corroborated by ≥2 "
+                     f"accounts")
+    for v in res.vid_inconsistencies:
+        if "error" in v:
+            lines.append(f"VID MISMATCH: {v['error']}")
+            continue
+        wit = "; ".join(f"{d}<-{','.join(w)}"
+                        for d, w in v["digests"].items())
+        lines.append(f"VID MISMATCH: root={v['root'][:24]} — nodes "
+                     f"read DIFFERENT payloads through one committed "
+                     f"commitment: {wit}")
     for m in res.sync_mismatches:
         lines.append(f"SYNC MISMATCH: {m}")
     for m in res.status_mismatches:

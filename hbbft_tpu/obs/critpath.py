@@ -37,10 +37,11 @@ same batch-absorb call, so the pairing error is the append cost, ~µs).
 **Decomposition (components sum EXACTLY to the measured total).**  Each
 reconstructed tx's milestones are clamped into a monotone chain
 ``submit → ingress → queued → epoch_start → first_rbc → rbc_end →
-aba_end → commit → commit_seen`` and consecutive differences become the
-components ``wire / pump_queue / mempool_wait / proposal_wait / rbc /
-aba / coin / decrypt`` (+ ``other`` for time the journals could not
-attribute — counted, never silently spread).  ``coin`` is carved out of
+aba_end → commit [→ commit_retrieved] → commit_seen`` and consecutive
+differences become the components ``wire / pump_queue / mempool_wait /
+proposal_wait / rbc / aba / coin / decrypt`` (+ ``retrieve`` for VID
+mode's post-ordering payload fetch, + ``other`` for time the journals
+could not attribute — counted, never silently spread).  ``coin`` is carved out of
 the ABA window (coin spans nest inside ABA rounds); matched inbound
 message delays on the committing node are carved out of the rbc/aba/
 decrypt windows into ``wire`` — a shaped 100 ms link shows up as wire
@@ -75,9 +76,11 @@ from hbbft_tpu.obs.spans import phase_group
 from hbbft_tpu.obs.trace import FlightTrace, iter_tids
 
 #: decomposition components, in chain order (``other`` = time the
-#: journals could not attribute to a phase — missing spans, torn tails)
+#: journals could not attribute to a phase — missing spans, torn tails;
+#: ``retrieve`` = VID mode's post-ordering payload fetch, the gap
+#: between the ``commit`` and ``commit_retrieved`` stages)
 COMPONENTS = ("wire", "pump_queue", "mempool_wait", "proposal_wait",
-              "rbc", "aba", "coin", "decrypt", "other")
+              "rbc", "aba", "coin", "decrypt", "retrieve", "other")
 
 
 def _digest(payload: bytes) -> str:
@@ -106,6 +109,10 @@ class _NodeData:
     queued: Dict[bytes, float] = field(default_factory=dict)
     # tid → (t, era, epoch) of the commit-stage trace on THIS node
     commit: Dict[bytes, Tuple[float, int, int]] = field(
+        default_factory=dict)
+    # tid → (t, era, epoch) of the commit_retrieved trace (VID mode:
+    # when the lazily-fetched payload resolved on THIS node)
+    commit_retrieved: Dict[bytes, Tuple[float, int, int]] = field(
         default_factory=dict)
     # (era, epoch) → earliest FlightCommit record t
     commit_rec_t: Dict[Tuple[int, int], float] = field(
@@ -165,6 +172,10 @@ def _extract(journals: Sequence[Journal]
                     elif rec.stage == "commit":
                         if tid not in nd.commit:
                             nd.commit[tid] = (rec.t, rec.era, rec.epoch)
+                    elif rec.stage == "commit_retrieved":
+                        if tid not in nd.commit_retrieved:
+                            nd.commit_retrieved[tid] = (rec.t, rec.era,
+                                                        rec.epoch)
             elif isinstance(rec, FlightCommit):
                 key = (rec.era, rec.epoch)
                 if key not in nd.commit_rec_t:
@@ -443,6 +454,12 @@ def _assemble(nodes: Dict[str, _NodeData],
             continue
         t_commit = commit_here[0] - h_off
         era, epoch = commit_here[1], commit_here[2]
+        # VID mode: when the lazily-retrieved payload became readable on
+        # the home node (== t_commit for locally-dispersed payloads,
+        # absent entirely in classic-RBC mode)
+        retrieved_here = nd.commit_retrieved.get(tid)
+        t_retrieved = (retrieved_here[0] - h_off
+                       if retrieved_here is not None else None)
         t_queued = nd.queued.get(tid)
         if t_queued is not None:
             t_queued -= h_off
@@ -508,6 +525,11 @@ def _assemble(nodes: Dict[str, _NodeData],
             del seg0
         else:
             take("other", t_commit)
+        # post-ordering retrieval (VID): commit → commit_retrieved — by
+        # construction the pre-retrieve components sum exactly to
+        # submit→commit, and adding ``retrieve`` extends the identity to
+        # submit→commit_retrieved
+        take("retrieve", t_retrieved)
         take("wire", t_seen)
         total = cur - start
         row = {
@@ -519,6 +541,8 @@ def _assemble(nodes: Dict[str, _NodeData],
             "t_submit": _r(t_submit) if t_submit is not None else None,
             "t_ingress": _r(t_ingress),
             "t_commit": _r(t_commit),
+            "t_commit_retrieved": (_r(t_retrieved)
+                                   if t_retrieved is not None else None),
             "t_commit_seen": _r(t_seen) if t_seen is not None else None,
             "t_ack": _r(t_ack) if t_ack is not None else None,
             "total_s": _r(total),

@@ -427,8 +427,9 @@ def _batch_key(out: Any) -> Optional[Tuple[int, int, bool]]:
     from hbbft_tpu.protocols.dynamic_honey_badger import DhbBatch
     from hbbft_tpu.protocols.honey_badger import Batch as HbBatch
     from hbbft_tpu.protocols.queueing_honey_badger import QhbBatch
+    from hbbft_tpu.protocols.vid import VidQhbBatch
 
-    if isinstance(out, (QhbBatch, DhbBatch)):
+    if isinstance(out, (QhbBatch, DhbBatch, VidQhbBatch)):
         complete = getattr(out.change, "state", None) == "complete"
         return (out.era, out.epoch, complete)
     if isinstance(out, HbBatch):

@@ -27,6 +27,8 @@ hop    stage        journaled by
 2      ``queued``   node, when the pump's worker thread dequeues the input
 3      ``commit``   every node, when the batch containing the tx commits
 4      ``commit_seen`` client, when the ``TX_COMMIT`` digest arrives
+4      ``commit_retrieved`` node (VID mode), when the lazily-retrieved
+       payload of a committed commitment resolves
 ====== ============ ======================================================
 
 A :class:`FlightTrace` record (wire tag ``0x95`` — registered like every
@@ -64,6 +66,11 @@ STAGE_HOPS = {
     "ingress": 1,
     "queued": 2,
     "commit": 3,
+    # VID mode: "commit" is the ordering instant (the epoch committed
+    # the (root, cert) commitment); "commit_retrieved" is when the
+    # payload itself became readable on the node — the gap between the
+    # two is the lazy-retrieval latency, off the ordering critical path
+    "commit_retrieved": 4,
     "commit_seen": 4,
 }
 

@@ -30,6 +30,7 @@ from hbbft_tpu.protocols.honey_badger import (
     SubsetWrap,
 )
 from hbbft_tpu.protocols.queueing_honey_badger import QhbBatch, QueueingHoneyBadger
+from hbbft_tpu.protocols.vid import VidDisperse, VidVote
 from hbbft_tpu.traits import ConsensusProtocol, Step, Target, TargetedMessage
 
 NodeId = Hashable
@@ -62,6 +63,10 @@ def message_key(msg: Any) -> EpochKey:
             f"SenderQueue: unknown HbWrap inner message {type(inner).__name__}"
         )
     if isinstance(msg, KeyGenWrap):
+        return (msg.era, 0)
+    if isinstance(msg, (VidDisperse, VidVote)):
+        # dispersal runs ahead of the epoch it will be proposed into:
+        # deliverable to any peer inside the message's era
         return (msg.era, 0)
     raise TypeError(
         f"SenderQueue: no epoch key rule for {type(msg).__name__}"

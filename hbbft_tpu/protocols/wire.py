@@ -198,6 +198,7 @@ def batch_bytes(b) -> bytes:
     from hbbft_tpu.protocols.dynamic_honey_badger import DhbBatch
     from hbbft_tpu.protocols.honey_badger import Batch as HbBatch
     from hbbft_tpu.protocols.queueing_honey_badger import QhbBatch
+    from hbbft_tpu.protocols.vid import VidQhbBatch
 
     if isinstance(b, QhbBatch):
         out = b"qhb" + u64(b.era) + u64(b.epoch)
@@ -205,6 +206,14 @@ def batch_bytes(b) -> bytes:
             out += node_id(proposer) + u32(len(txs))
             for tx in txs:
                 out += blob(tx)
+        return out + _change_state_bytes(b.change)
+    if isinstance(b, VidQhbBatch):
+        # VID mode folds the ORDERED commitments, not the retrieved
+        # transactions: the digest chain stays a pure ordering artifact,
+        # so nodes at different retrieval depths still share a prefix
+        out = b"vqhb" + u64(b.era) + u64(b.epoch)
+        for proposer, payload in b.contributions:
+            out += node_id(proposer) + blob(payload)
         return out + _change_state_bytes(b.change)
     if isinstance(b, DhbBatch):
         out = b"dhb" + u64(b.era) + u64(b.epoch)
@@ -526,6 +535,49 @@ def _lazy_register():
     _register(0x94, SyncNack,
               lambda m: s(m.reason),
               lambda r: SyncNack(rs(r)))
+    # verifiable information dispersal (protocols/vid.py) --------------------
+    from hbbft_tpu.protocols.vid import (
+        VidCert, VidDisperse, VidRetrieve, VidShard, VidVote,
+    )
+
+    def rt32(r: Reader) -> bytes:
+        return r.take(32)
+
+    def enc_cert(m: VidCert) -> bytes:
+        out = (u64(m.era) + m.root + u64(m.total_len)
+               + u32(len(m.votes)))
+        for nid, sig in m.votes:
+            out += node_id(nid) + signature(sig)
+        return out
+
+    def dec_cert(r: Reader) -> VidCert:
+        era = r.u64()
+        root = rt32(r)
+        total_len = r.u64()
+        n = r.u32()
+        if n > 4096:
+            raise ValueError("absurd vote count")
+        votes = tuple(
+            (read_node_id(r), read_signature(r)) for _ in range(n)
+        )
+        return VidCert(era, root, total_len, votes)
+
+    _register(0xA0, VidDisperse,
+              lambda m: (u64(m.era) + m.root + u64(m.total_len)
+                         + proof_bytes(m.proof)),
+              lambda r: VidDisperse(r.u64(), rt32(r), r.u64(),
+                                    read_proof(r)))
+    _register(0xA1, VidVote,
+              lambda m: u64(m.era) + m.root + signature(m.sig),
+              lambda r: VidVote(r.u64(), rt32(r), read_signature(r)))
+    _register(0xA2, VidCert, enc_cert, dec_cert)
+    _register(0xA3, VidRetrieve,
+              lambda m: m.root,
+              lambda r: VidRetrieve(rt32(r)))
+    _register(0xA4, VidShard,
+              lambda m: (m.root + u64(m.total_len)
+                         + proof_bytes(m.proof)),
+              lambda r: VidShard(rt32(r), r.u64(), read_proof(r)))
     # per-tx causal trace record (obs/trace.py) ------------------------------
     from hbbft_tpu.obs.trace import FlightTrace
 

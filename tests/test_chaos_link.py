@@ -92,6 +92,20 @@ def test_bandwidth_cap_serializes_per_edge():
         pytest.approx(0.1)]
 
 
+def test_backlog_s_reads_the_bandwidth_queue():
+    """backlog_s is the congestion signal the transport's VID shed path
+    reads: seconds of bulk already committed to the edge, draining with
+    time, zero for unshaped/idle edges."""
+    shaper = LinkShaper(NetShape(default=ShapedLink(bandwidth_bps=8000.0)))
+    assert shaper.backlog_s(0, 1, 0.0) == 0.0  # untouched edge
+    shaper.shape_frame(0, 1, 0.0, nbytes=100)  # 0.1 s on the wire
+    shaper.shape_frame(0, 1, 0.0, nbytes=100)
+    assert shaper.backlog_s(0, 1, 0.0) == pytest.approx(0.2)
+    assert shaper.backlog_s(0, 1, 0.15) == pytest.approx(0.05)  # drains
+    assert shaper.backlog_s(0, 1, 5.0) == 0.0   # fully drained
+    assert shaper.backlog_s(1, 0, 0.0) == 0.0   # other direction idle
+
+
 def test_partition_hold_delivers_at_heal_and_counts():
     link = ShapedLink(partitions=((1.0, 3.0),))
     shaper = LinkShaper(NetShape(default=link))

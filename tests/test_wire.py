@@ -216,6 +216,22 @@ def _trace_sample():
                        pack_tids([trace_id(b"tx-a"), trace_id(b"tx-b")]))
 
 
+def _vid_samples(sig):
+    from hbbft_tpu.protocols.vid import (
+        VidCert, VidDisperse, VidRetrieve, VidShard, VidVote,
+    )
+
+    tree = MerkleTree([b"vid-shard-%d" % i for i in range(4)])
+    root = tree.root_hash()
+    return [
+        VidDisperse(2, root, 4096, tree.proof(1)),
+        VidVote(2, root, sig),
+        VidCert(2, root, 4096, ((0, sig), (1, sig), (2, sig))),
+        VidRetrieve(root),
+        VidShard(root, 4096, tree.proof(3)),
+    ]
+
+
 def _sync_samples():
     from hbbft_tpu.net.statesync import (
         SyncChunk, SyncChunkReq, SyncManifest, SyncManifestReq, SyncNack,
@@ -236,7 +252,7 @@ def _sample_messages(crypto_bits):
     share, dshare, sig = crypto_bits
     tree = MerkleTree([b"shard-%d" % i for i in range(7)])
     skg = SignedKeyGenMsg(1, 3, "ack", b"\x00\x01\x02", sig)
-    return _flight_samples() + _sync_samples() + [
+    return _flight_samples() + _sync_samples() + _vid_samples(sig) + [
         ValueMsg(tree.proof(3)),
         EchoMsg(tree.proof(0)),
         ReadyMsg(tree.root_hash()),
@@ -332,7 +348,7 @@ def test_every_registered_type_roundtrips_and_hashes(crypto_bits):
         EpochStarted((3, 11)),
         AlgoMessage(HbWrap(0, SubsetWrap(0, BroadcastWrap(
             0, EchoMsg(tree.proof(1)))))),
-    ] + _flight_samples() + _sync_samples()
+    ] + _flight_samples() + _sync_samples() + _vid_samples(sig)
     wire.ensure_registered()
     sampled = {type(m) for m in samples}
     registered = set(wire._MSG_TAGS)
