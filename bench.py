@@ -1390,6 +1390,7 @@ def _net_run_once(epochs_target: int, n: int, batch_size: int,
                   vid: bool = False, chaos: str = "",
                   ingress_workers: bool = False,
                   wave_limit_factor: int = 50,
+                  watch: bool = False,
                   tag: str = "run"):
     """One localhost cluster measurement: spawn ``n`` node processes,
     pump client transactions until every node committed ``epochs_target``
@@ -1437,6 +1438,45 @@ def _net_run_once(epochs_target: int, n: int, batch_size: int,
     procs = {nid: spawn_node(cfg, nid, stdout=subprocess.DEVNULL,
                              stderr=subprocess.STDOUT)
              for nid in range(n)}
+    # --net-watch: the live health plane rides along for the WHOLE
+    # measured window — watchtower scraping every node's obs endpoint
+    # and the streaming auditor tailing the flight journals — so
+    # comparing this run against a plain baseline prices the plane's
+    # overhead (the ≤5% epochs/s rule gated by --compare)
+    wt = None
+    watch_stop = None
+    watch_thread = None
+    if watch:
+        import threading
+
+        from hbbft_tpu.obs.watch import Watchtower
+
+        # bounded in-window cost: journal decode capped per poll (the
+        # backlog drains after the timed section) and the full audit
+        # derivation runs every 4th tick — the plane stays attached and
+        # detecting all run long, it just can't out-spend its ≤5% budget
+        # by re-deriving over a hot journal twice a second
+        wt = Watchtower([cfg.metrics_addr(nid) for nid in range(n)],
+                        journal_roots=[flight_root],
+                        scrape_timeout_s=1.0,
+                        max_read_bytes=64 * 2**10,
+                        derive_ticks=4)
+        watch_stop = threading.Event()
+
+        def _watch_loop():
+            while not watch_stop.is_set():
+                try:
+                    wt.tick(time.monotonic())
+                except Exception as exc:
+                    print(f"# watchtower tick failed: {exc!r}",
+                          file=sys.stderr)
+                watch_stop.wait(1.0)
+
+        # started inside session() once the nodes answer — scraping
+        # half-spawned processes would charge their startup window as
+        # target_down incidents against a healthy run
+        watch_thread = threading.Thread(
+            target=_watch_loop, name="bench-watch", daemon=True)
     # driver policy: depth 1 reproduces the r01/r02 serialized
     # submit→wait→repeat loop exactly; deeper pipelines keep two
     # half-size waves in flight — enough standing load to feed the
@@ -1453,6 +1493,8 @@ def _net_run_once(epochs_target: int, n: int, batch_size: int,
                 trace_dir=os.path.join(flight_root, f"client-{nid}"))
             for nid in range(n)
         ]
+        if watch_thread is not None:
+            watch_thread.start()  # nodes are up: the plane attaches now
         rng = random.Random(17)
         t0 = time.monotonic()
         wave = 0
@@ -1542,6 +1584,23 @@ def _net_run_once(epochs_target: int, n: int, batch_size: int,
 
     try:
         net = asyncio.run(session())
+        if wt is not None:
+            watch_stop.set()
+            watch_thread.join(timeout=10.0)
+            # the timed section is over: drain whatever backlog the
+            # bounded per-tick reads deferred, then seal the audit
+            while wt.tailer.poll():
+                pass
+            wt.tailer.finalize()
+            net["watch"] = {
+                "ticks": wt.ticks,
+                "incidents": sorted(
+                    {(i["kind"], i["subject"]) for i in wt.incidents}),
+                "audit_verdict": wt.tailer.result().verdict,
+                "audit_records": wt.tailer.auditor.records_fed,
+                "scrape_failures": int(wt._c_scrape_fail.total()),
+            }
+            wt.close()
         # every node's epoch-phase spans, while the processes are still up
         span_dicts = []
         for nid in range(n):
@@ -1557,6 +1616,8 @@ def _net_run_once(epochs_target: int, n: int, batch_size: int,
             )
         net["phases"] = _net_phase_summary(span_dicts)
     finally:
+        if watch_stop is not None:
+            watch_stop.set()
         shutdown_procs(procs.values())
     # journals are fully flushed once the node processes exited: merge
     # them with the client trace journals into the per-tx critical path
@@ -1768,7 +1829,8 @@ def net_ingest_sweep(shapes=tuple(INGEST_SHAPES)):
 def net_cluster_bench(epochs_target: int = 20, n: int = 4,
                       batch_size: int = 8, tx_size: int = 64,
                       depths=(1,), crypto_phases: bool = True,
-                      ingest_sweep: bool = True):
+                      ingest_sweep: bool = True,
+                      watch: bool = False):
     """Localhost 4-node networked QHB benchmark (`--net`).
 
     Sweeps ``--pipeline-depth`` values (each a full cluster run of
@@ -1791,7 +1853,8 @@ def net_cluster_bench(epochs_target: int = 20, n: int = 4,
 
     runs = [
         _net_run_once(epochs_target, n, batch_size, tx_size,
-                      pipeline_depth=depth, tag=f"depth{depth}")
+                      pipeline_depth=depth, watch=watch,
+                      tag=f"depth{depth}")
         for depth in depths
     ]
     best = max(runs, key=lambda r: r["epochs_per_s"])
@@ -1890,6 +1953,8 @@ def net_cluster_bench(epochs_target: int = 20, n: int = 4,
         "phases": best["phases"],
         "transport": best["transport"],
     }
+    if "watch" in best:
+        line["watch"] = best["watch"]
     if ingest_sweep:
         line["ingest_sweep"] = net_ingest_sweep()
     if crypto is not None:
@@ -2087,7 +2152,9 @@ def compare_bench(old, new, threshold: float = 0.15,
     # rates and the chaos campaign's clean fraction are higher-better;
     # latencies/durations below are lower-better
     if meshes_match:
-        add("value", unit.endswith("/s") or unit == "clean_fraction",
+        add("value",
+            unit.endswith("/s")
+            or unit in ("clean_fraction", "flagged_fraction"),
             threshold)
     for lat in ("p50_latency_ms", "p99_latency_ms"):
         add(lat, False, threshold)
@@ -2188,6 +2255,45 @@ def compare_bench(old, new, threshold: float = 0.15,
                 "threshold_pct": round(100 * threshold, 2),
                 "regressed": -delta > threshold,
             })
+    # BENCH_OBS trajectory (chaos_online_detection): per-cell detection
+    # latency is lower-better, gated only at equal cell name (a grid
+    # that adds or drops cells contributes nothing for the non-matching
+    # ones); the aggregate flagged_fraction gates higher-better through
+    # the headline "value" rule above.  A clean-cell false alarm is an
+    # absolute regression: the baseline's count is the ceiling.
+    def detect_map(doc):
+        return {
+            e.get("cell"): e
+            for e in doc.get("detection", ()) if isinstance(e, dict)
+        }
+
+    old_det, new_det = detect_map(old), detect_map(new)
+    for cell in sorted(k for k in old_det if k in new_det):
+        o, nv = old_det[cell].get("detect_s"), new_det[cell].get(
+            "detect_s")
+        if not isinstance(o, (int, float)) \
+                or not isinstance(nv, (int, float)) or o <= 0:
+            continue
+        delta = (nv - o) / o
+        checks.append({
+            "name": f"detect[{cell}].detect_s",
+            "old": o,
+            "new": nv,
+            "delta_pct": round(100 * delta, 2),
+            "threshold_pct": round(100 * threshold, 2),
+            "regressed": delta > threshold,
+        })
+    o_fa, n_fa = (old.get("clean_false_alarms"),
+                  new.get("clean_false_alarms"))
+    if isinstance(o_fa, int) and isinstance(n_fa, int):
+        checks.append({
+            "name": "clean_false_alarms",
+            "old": o_fa,
+            "new": n_fa,
+            "delta_pct": round(100.0 * (n_fa - o_fa) / max(o_fa, 1), 2),
+            "threshold_pct": 0.0,
+            "regressed": n_fa > o_fa,
+        })
     # MULTICHIP trajectory (dryrun_multichip's emitted record): per
     # device-count epochs/s is a higher-better rate, gated only at equal
     # n_devices — like the chaos campaign's clean_fraction, dropping a
@@ -2293,6 +2399,15 @@ def main(argv=None):
              "and MB/s under ingest_sweep)",
     )
     ap.add_argument(
+        "--net-watch", action="store_true",
+        help="attach the live health plane to --net: a watchtower "
+             "scrapes every node's obs endpoint and the streaming "
+             "auditor tails the flight journals for the whole measured "
+             "window — compare against a plain --net baseline from the "
+             "same host/session to price the overhead (≤5%% epochs/s: "
+             "--compare --compare-threshold 0.05)",
+    )
+    ap.add_argument(
         "--mesh", default="", metavar="auto|none|K|AxB",
         help="device mesh for the hb-epoch* configs (sets "
              "HBBFT_EPOCH_MESH): 'auto' shards over all devices when >1 "
@@ -2343,6 +2458,7 @@ def main(argv=None):
             epochs_target=args.net, depths=depths or (1,),
             crypto_phases=not args.net_no_crypto_phases,
             ingest_sweep=not args.net_no_ingest_sweep,
+            watch=args.net_watch,
         )
         return
 

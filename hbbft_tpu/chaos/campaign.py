@@ -45,6 +45,11 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from hbbft_tpu.chaos.link import PRESETS, preset_shape
 from hbbft_tpu.obs import critpath as _critpath
 from hbbft_tpu.obs.audit import AuditResult, run_audit
+from hbbft_tpu.obs.audit_stream import (
+    IncrementalAuditor,
+    JournalTailer,
+    extract_incidents,
+)
 from hbbft_tpu.protocols.dynamic_honey_badger import DynamicHoneyBadger
 from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
 from hbbft_tpu.protocols.queueing_honey_badger import (
@@ -305,10 +310,45 @@ def _cell_critpath(cell_dir: str) -> Optional[Dict[str, Any]]:
     }
 
 
+#: crank period between streaming-audit polls in simulator cells: the
+#: online detector's tick.  The recorder flushes every append, so each
+#: poll sees all evidence journaled up to that crank.  Fine-grained on
+#: purpose: a quiet unshaped cell can go quiescent inside a few hundred
+#: cranks, and the online-detection claim needs polls DURING the run.
+#: Cheap by construction — the tailer read is incremental, and the
+#: result() derivation only runs while a configured-faulty cell is
+#: still undetected (clean cells never derive mid-run).
+WATCH_POLL_CRANKS = 200
+
+
+def _watch_online(tailer: JournalTailer, faulty: frozenset,
+                  watch: Dict[str, Any], virtual_s: float,
+                  cranks: int) -> None:
+    """One online-detection check: did the streaming auditor just raise
+    an incident naming a configured-faulty node?  First hit stamps the
+    detection time (the cell's virtual clock — the online-detection
+    latency the BENCH_OBS family records)."""
+    if watch["detected_online"] or not faulty:
+        return
+    for fi in extract_incidents(tailer.result()):
+        if fi["subject"] in faulty:
+            watch["detected_online"] = True
+            watch["detect_virtual_s"] = round(virtual_s, 6)
+            watch["detect_cranks"] = cranks
+            watch["first_kind"] = fi["kind"]
+            return
+
+
 def run_cell(spec: CellSpec, cell_dir: str
              ) -> Tuple[Dict[str, Any], AuditResult]:
     """One simulator cell: run, record, audit.  Returns the per-cell
-    report dict and the audit result."""
+    report dict and the audit result.
+
+    The streaming auditor rides along: a :class:`JournalTailer` polls
+    the cell's journals every ``WATCH_POLL_CRANKS`` cranks DURING the
+    run (the watchtower's sim-cell stand-in — virtual clock, no
+    sockets), so a Byzantine cell's report carries whether the fault
+    was flagged online and at what virtual-time latency."""
     infos = _infos_for(spec.n)
     builder = (
         NetBuilder(list(range(spec.n)))
@@ -323,10 +363,26 @@ def run_cell(spec: CellSpec, cell_dir: str
     net = builder.using_step(lambda nid: _qhb_stack(infos, nid, spec))
     for i in range(spec.txs):
         net.send_input(i % spec.n, TxInput(b"chaos-%04d" % i))
+    tailer = JournalTailer([cell_dir], IncrementalAuditor(max_events=0))
+    faulty_names = frozenset(str(nid) for nid in spec.faulty)
+    watch: Dict[str, Any] = {
+        "detected_online": False, "detect_virtual_s": None,
+        "detect_cranks": None, "first_kind": None, "incidents": [],
+    }
     while net.cranks < spec.crank_limit:
         if net.crank() is None:
             break
+        if net.cranks % WATCH_POLL_CRANKS == 0:
+            tailer.poll()
+            _watch_online(tailer, faulty_names, watch,
+                          net.virtual_time, net.cranks)
     net.close_observers()
+    # boundary poll: evidence flushed at close still counts, but is NOT
+    # online detection (the cell had already ended)
+    tailer.finalize()
+    watch["incidents"] = sorted(
+        {(fi["kind"], fi["subject"])
+         for fi in extract_incidents(tailer.result())})
     res, _journals = run_audit([cell_dir])
     correct = [nid for nid in range(spec.n) if nid not in spec.faulty]
     batches = {
@@ -357,6 +413,7 @@ def run_cell(spec: CellSpec, cell_dir: str
         "overload_attributed_to": [
             o["peer"] for o in res.overload_incidents
         ],
+        "watch": watch,
         "critical_path": _cell_critpath(cell_dir),
         "journal": cell_dir,
     }
@@ -554,6 +611,8 @@ async def _socket_scenario(spec: CellSpec, cell_dir: str
                 await asyncio.wait_for(stop_sampling.wait(), 0.1)
 
     sampler = None
+    tower = None
+    watcher = None
     try:
         if flooding:
             # the flood injector holds the claimed validator's REAL
@@ -585,6 +644,42 @@ async def _socket_scenario(spec: CellSpec, cell_dir: str
             injector_task = asyncio.ensure_future(injector.run(
                 cluster.addrs[0], cfg.cluster_id, identity=spec.n - 1,
                 duration_s=8.0))
+        # the live watchtower: scrape every node's obs endpoint AND tail
+        # the cell's journals through the streaming auditor while the
+        # scenario runs — online detection on a REAL cluster, wall-clock
+        # latency measured from scenario start
+        from hbbft_tpu.obs.watch import Watchtower
+
+        tower = Watchtower(
+            [cluster.metrics_addrs[nid] for nid in range(spec.n)],
+            journal_roots=[cell_dir], scrape_timeout_s=1.0)
+        faulty_names = frozenset(str(nid) for nid in spec.faulty)
+        watch_doc: Dict[str, Any] = {
+            "detected_online": False, "detect_wall_s": None,
+            "first_kind": None, "incidents": [],
+        }
+        loop = asyncio.get_event_loop()
+        # hblint: disable=det-wall-clock (live watchtower over a
+        # real-time cluster: wall clock IS the measured detection
+        # latency, same clock as the cell's liveness measurement)
+        watch_t0 = time.monotonic()
+
+        async def watch_loop():
+            while not stop_sampling.is_set():
+                # hblint: disable=det-wall-clock (same measured clock)
+                now = time.monotonic()
+                new = await loop.run_in_executor(None, tower.tick, now)
+                for inc in new:
+                    if (not watch_doc["detected_online"]
+                            and inc["subject"] in faulty_names):
+                        watch_doc["detected_online"] = True
+                        watch_doc["detect_wall_s"] = round(
+                            now - watch_t0, 3)
+                        watch_doc["first_kind"] = inc["kind"]
+                with contextlib.suppress(asyncio.TimeoutError):
+                    await asyncio.wait_for(stop_sampling.wait(), 0.5)
+
+        watcher = asyncio.ensure_future(watch_loop())
         sampler = asyncio.ensure_future(sample_gauges())
         client = await cluster.client(
             0, trace_dir=os.path.join(cell_dir, "client-0"))
@@ -615,11 +710,22 @@ async def _socket_scenario(spec: CellSpec, cell_dir: str
         batches = [len(rt.batches) for rt in cluster.runtimes]
         stop_sampling.set()
         await sampler
+        await watcher
+        # boundary poll: evidence flushed at teardown still lands in the
+        # incident list, but detect_wall_s only ever records ONLINE hits
+        tower.tailer.finalize()
+        for fi in extract_incidents(tower.tailer.result()):
+            tower.incidents.append(
+                {"kind": fi["kind"], "severity": fi["severity"],
+                 "subject": fi["subject"]})
+        watch_doc["incidents"] = sorted(
+            {(i["kind"], i["subject"]) for i in tower.incidents})
         out = {
             "batches_min": min(batches),
             "batches_max": max(batches),
             "commit_wall_s": round(wall, 3),
             "common_prefix_len": len(prefix),
+            "watch": watch_doc,
         }
         if flooding:
             guard_docs = [rt.transport.ingress.as_dict()
@@ -681,6 +787,12 @@ async def _socket_scenario(spec: CellSpec, cell_dir: str
         if sampler is not None:
             with contextlib.suppress(asyncio.CancelledError, Exception):
                 await sampler
+        if watcher is not None:
+            watcher.cancel()
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await watcher
+        if tower is not None:
+            tower.close()
         if injector_task is not None:
             injector_task.cancel()
             with contextlib.suppress(asyncio.CancelledError, Exception):
@@ -705,6 +817,7 @@ def run_socket_cell(spec: CellSpec, cell_dir: str
         "commit_wall_s": live["commit_wall_s"],
         "common_prefix_len": live["common_prefix_len"],
         "pipeline_depth": spec.pipeline_depth,
+        "watch": live.get("watch"),
         "critical_path": _cell_critpath(cell_dir),
         "journal": cell_dir,
     }
@@ -924,6 +1037,75 @@ def run_campaign(specs: Sequence[CellSpec], journal_root: str,
     return report
 
 
+#: incident kinds that constitute an ALARM (fault/fork classes) — the
+#: info-class kinds (overload attribution, restart re-proposals) are
+#: working-as-designed annotations, not alarms, and never count as a
+#: false positive on a clean cell
+ALARM_KINDS = frozenset({
+    "fork", "self_fork", "sync_mismatch", "vid_mismatch",
+    "status_mismatch", "equivocation", "monotonicity",
+})
+
+
+def build_obs_report(report: Dict[str, Any]) -> Dict[str, Any]:
+    """Distill the campaign's per-cell watch blocks into the BENCH_OBS
+    online-detection record: for every cell with a configured Byzantine
+    node, was the fault flagged ONLINE (incident naming the faulty node
+    before the cell ended) and at what detection latency; for every
+    clean cell, did the live plane stay silent.  Sim cells measure
+    latency on the virtual clock, socket cells on the wall clock —
+    ``clock`` says which."""
+    detection: List[Dict[str, Any]] = []
+    false_alarms: List[Dict[str, Any]] = []
+    fault_cells = flagged = 0
+    lat: List[float] = []
+    for d in report["cells_detail"]:
+        w = d.get("watch")
+        if not w:
+            continue
+        spec = CellSpec.from_dict(d.get("spec", {}))
+        if spec.faulty:
+            fault_cells += 1
+            detect_s, clock = w.get("detect_virtual_s"), "virtual"
+            if detect_s is None and w.get("detect_wall_s") is not None:
+                detect_s, clock = w.get("detect_wall_s"), "wall"
+            if w.get("detected_online"):
+                flagged += 1
+                if detect_s is not None:
+                    lat.append(detect_s)
+            detection.append({
+                "cell": d["cell"],
+                "adversary": spec.adversary,
+                "detected_online": bool(w.get("detected_online")),
+                "kind": w.get("first_kind"),
+                "detect_s": detect_s,
+                "clock": clock,
+                "detect_cranks": w.get("detect_cranks"),
+            })
+        else:
+            alarms = sorted(
+                tuple(i) for i in w.get("incidents", ())
+                if tuple(i)[0] in ALARM_KINDS)
+            if alarms and d.get("verdict") == "clean":
+                false_alarms.append(
+                    {"cell": d["cell"], "incidents": alarms})
+    lat.sort()
+    return {
+        "metric": "chaos_online_detection",
+        "value": (round(flagged / fault_cells, 4)
+                  if fault_cells else None),
+        "unit": "flagged_fraction",
+        "fault_cells": fault_cells,
+        "flagged_online": flagged,
+        "clean_false_alarms": len(false_alarms),
+        "false_alarm_cells": false_alarms,
+        "detect_p50_s": (round(lat[len(lat) // 2], 6) if lat else None),
+        "detect_max_s": (round(lat[-1], 6) if lat else None),
+        "detection": detection,
+        "clean_fraction": report.get("value"),
+    }
+
+
 # ===========================================================================
 # CLI
 # ===========================================================================
@@ -981,6 +1163,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="cap the grid (0 = run everything)")
     ap.add_argument("--out", default="",
                     help="write the JSON report here (default: stdout)")
+    ap.add_argument("--obs-out", default="",
+                    help="also write the BENCH_OBS online-detection "
+                         "record (per-cell detection latency) here")
     ap.add_argument("--journal-root", default="",
                     help="keep cell journals under this directory "
                          "(default: a temp dir, deleted after the run)")
@@ -1020,6 +1205,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         report = run_campaign(specs, root,
                               verify_nonclean=not args.no_verify,
                               progress=progress)
+        if args.obs_out:
+            obs_doc = build_obs_report(report)
+            with open(args.obs_out, "w", encoding="utf-8") as fh:
+                fh.write(json.dumps(obs_doc) + "\n")
+            print(f"# online-detection record written to "
+                  f"{args.obs_out} (flagged "
+                  f"{obs_doc['flagged_online']}/"
+                  f"{obs_doc['fault_cells']}, false alarms "
+                  f"{obs_doc['clean_false_alarms']})",
+                  file=sys.stderr)
         if not keep:
             # journals were a working set; the report is the artifact
             for d in report["cells_detail"]:

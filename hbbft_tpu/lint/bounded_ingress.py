@@ -163,7 +163,12 @@ def _is_sender_valued(call: ast.Call, params: Set[str]) -> bool:
 @register
 class BoundedIngressChecker(Checker):
     name = "bounded-ingress"
-    scope = ("hbbft_tpu/net/", "hbbft_tpu/protocols/")
+    # obs/audit_stream.py and obs/watch.py joined with the live health
+    # plane: both consume unbounded external input (journal bytes,
+    # scraped endpoints) in long-running processes, so their state must
+    # show the same bounding evidence as the network ingress paths
+    scope = ("hbbft_tpu/net/", "hbbft_tpu/protocols/",
+             "hbbft_tpu/obs/audit_stream.py", "hbbft_tpu/obs/watch.py")
     rules = {
         "bounded-ingress":
             "a self.* collection grown from network-derived input in "

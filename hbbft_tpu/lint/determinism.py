@@ -134,10 +134,19 @@ class DeterminismChecker(Checker):
     # takes `now` from the caller (the runtime's pump), so the retrieval
     # state machine replays deterministically under the simulator — a
     # wall-clock read inside it would break that
+    # obs/audit_stream.py joined with the streaming auditor: the
+    # incremental core must produce byte-identical verdicts to the batch
+    # CLI over the same journal bytes, regardless of feed order or poll
+    # cadence — any clock or RNG read would fork that equivalence
+    # obs/watch.py is clock-free by the same contract as net/retrieve:
+    # Watchtower.tick(now, ...) takes the caller's clock (virtual in sim
+    # cells, scripted in tests); only the CLI loop reads wall time,
+    # under justified suppressions
     scope = ("hbbft_tpu/protocols/", "hbbft_tpu/parallel/",
              "hbbft_tpu/crypto/", "hbbft_tpu/chaos/",
              "hbbft_tpu/ops/rs.py", "hbbft_tpu/obs/trace.py",
-             "hbbft_tpu/net/retrieve.py")
+             "hbbft_tpu/net/retrieve.py", "hbbft_tpu/obs/audit_stream.py",
+             "hbbft_tpu/obs/watch.py")
     rules = {
         "det-wall-clock":
             "wall-clock read in consensus-core code (time.time, "

@@ -535,6 +535,12 @@ class NodeRuntime:
         # degrade=False.
         self.degrade = (_attach_degrade(self, **(degrade_kwargs or {}))
                         if degrade else None)
+        # live health plane (obs/watch.py): last locally-evaluated
+        # health status, updated on the pump heartbeat so ok↔degraded
+        # transitions are journaled as HealthIncident records from the
+        # one thread allowed to append
+        self._health_status = "ok"
+        self._health_transitions = 0
 
     # -- transport authentication --------------------------------------------
 
@@ -623,6 +629,39 @@ class NodeRuntime:
             deadline = self._retrieve.next_deadline()
             if deadline is not None and time.time() >= deadline:
                 self.pump.enqueue("vid_tick")
+        self._health_tick()
+
+    def _health_issues(self) -> List[str]:
+        """Locally-observable health problems, cheap enough for every
+        heartbeat: the degradation controller being engaged, and the
+        mempool running at ≥90% of its admission capacity."""
+        issues: List[str] = []
+        if self.degrade is not None and self.degrade.level:
+            issues.append("degrade_active")
+        cap = self.mempool.capacity
+        if cap and len(self.mempool) * 10 >= cap * 9:
+            issues.append("mempool_pressure")
+        return issues
+
+    def _health_tick(self) -> None:
+        """Journal one HealthIncident per local ok↔degraded transition
+        (pump thread — the only thread allowed to append).  Transitions,
+        not levels: a sustained degrade writes one record when it
+        engages and one when it recovers, never one per heartbeat."""
+        issues = self._health_issues()
+        status = "degraded" if issues else "ok"
+        if status == self._health_status:
+            return
+        prev, self._health_status = self._health_status, status
+        self._health_transitions += 1
+        if self.flight is not None:
+            me = repr(self.our_id())
+            self.flight.recorder.record_incident(
+                "local_health",
+                "warn" if status == "degraded" else "info", me,
+                f"local_health:{me}:{self._health_transitions}",
+                f"{prev}->{status}"
+                + (f": {','.join(issues)}" if issues else ""))
 
     def _vid_note(self, kind: str, detail: str) -> None:
         """RetrieveService loudness sink → flight journal (the service's
@@ -852,7 +891,7 @@ class NodeRuntime:
     async def start_obs(self, host: str = "127.0.0.1",
                         port: int = 0) -> Addr:
         """Serve ``/metrics``, ``/status``, ``/spans``, ``/flight``,
-        ``/trace`` (see obs.http)."""
+        ``/trace``, ``/health`` (see obs.http)."""
         self._obs_server = ObsServer(
             self.registry,
             status_fn=self.status_doc,
@@ -861,6 +900,7 @@ class NodeRuntime:
                        if self.flight is not None else None),
             trace_fn=(self.flight.recorder.trace_jsonl
                       if self.flight is not None else None),
+            health_fn=self.health_doc,
         )
         self.obs_addr = await self._obs_server.start(host, port)
         return self.obs_addr
@@ -1849,4 +1889,58 @@ class NodeRuntime:
             ),
             "obs_addr": list(self.obs_addr) if self.obs_addr else None,
             "stats": self.transport.stats.as_dict(),
+        }
+
+    def health_doc(self) -> dict:
+        """The ``/health`` document: machine-readable status + headroom.
+
+        Shaped for the adaptive-control ladder (ROADMAP 5(b)): every
+        lever the controller could pull is reported as used/cap/frac so
+        "how much room is left" needs no endpoint-specific knowledge.
+        Read-only snapshot — safe from the obs HTTP thread; the
+        journaled transition record is the pump heartbeat's job
+        (:meth:`_health_tick`)."""
+        era, epoch = self.current_key()
+        issues = self._health_issues()
+
+        def lever(used: int, cap: int) -> dict:
+            return {"used": used, "cap": cap,
+                    "frac": round(used / cap, 4) if cap else 0.0}
+
+        mp = self.mempool
+        hb = self._inner_hb()
+        return {
+            "node": repr(self.our_id()),
+            "status": "degraded" if issues else "ok",
+            "issues": issues,
+            "transitions": self._health_transitions,
+            "era": era,
+            "epoch": epoch,
+            "chain_len": self.chain_len,
+            "headroom": {
+                "mempool": lever(len(mp), mp.capacity),
+                "mempool_bytes": lever(mp.pending_bytes,
+                                       mp.max_pending_bytes),
+                "pipeline": lever(
+                    len(hb.epochs) if hb is not None else 0,
+                    self.pipeline_depth),
+                # the pump drains max_batch events per iteration: a
+                # backlog persistently above it means the node is
+                # processing-bound, not waiting for traffic
+                "pump_backlog": lever(self.pump.pending(),
+                                      self.pump.max_batch),
+                "vid_pending": (self._retrieve.pending_count()
+                                if self._retrieve is not None else 0),
+            },
+            "degrade": (self.degrade.as_dict()
+                        if self.degrade is not None else None),
+            "guard": {
+                "senderq_evictions": int(self._c_sq_evict.total()),
+                "mempool_sheds": sum(self.mempool.sheds.values()),
+            },
+            "peers_connected": sum(
+                1 for p in self.transport.peer_ids()
+                if self.transport.connected(p)
+            ),
+            "send_failures": self.send_failures,
         }

@@ -36,163 +36,28 @@ mid-record) are skipped loudly and counted, never fatal.
 from __future__ import annotations
 
 import argparse
-import hashlib
 import json
 import sys
-from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-from hbbft_tpu.fault_log import FaultKind, equivocation_kinds
+from hbbft_tpu.obs.audit_stream import (  # noqa: F401 — re-exported API
+    _OVERLOAD_FAULT_KINDS,
+    _RANK,
+    _digest,
+    _is_restart_reproposal,
+    _parse_guard_note,
+    _parse_statesync_note,
+    _parse_vid_note,
+    AuditResult,
+    Event,
+    IncrementalAuditor,
+    equivocation_key,
+)
 from hbbft_tpu.obs.flight import (
-    FlightCommit,
-    FlightFault,
-    FlightMsg,
-    FlightNote,
-    FlightSpan,
     Journal,
     find_journal_dirs,
     read_journal,
-    target_covers,
 )
-from hbbft_tpu.protocols import wire
-
-#: timeline ordering rank per record family (notes lead their epoch,
-#: then sends/receives, commits close it, spans/faults trail as derived)
-_RANK = {"note": 0, "msg": 1, "commit": 2, "span": 3, "fault": 4}
-
-
-#: FlightFault kinds that are protocol-layer overload evidence (flood
-#: budgets engaging), as opposed to protocol misbehavior of other shapes
-_OVERLOAD_FAULT_KINDS = frozenset({
-    "FutureEpochFlood", "SubsetMessageFlood",
-})
-
-
-def _parse_guard_note(detail: str) -> Optional[Dict[str, str]]:
-    """``kind=K peer=P …`` → {kind, peer[, claimed]} (the runtime's
-    overload-guard journal format; see NodeRuntime._process_guard_event).
-    ``auth_fail`` notes carry both sides of a spoof: ``peer`` is the
-    ATTACKER's socket endpoint, ``claimed`` the impersonated identity —
-    keeping them separate is what lets the incident report blame the
-    endpoint without smearing the victim."""
-    fields = dict(
-        part.split("=", 1) for part in detail.split() if "=" in part
-    )
-    if "kind" not in fields or "peer" not in fields:
-        return None
-    out = {"kind": fields["kind"], "peer": fields["peer"]}
-    if "claimed" in fields:
-        out["claimed"] = fields["claimed"]
-    return out
-
-
-def _parse_statesync_note(detail: str) -> Optional[Dict[str, Any]]:
-    """``index=N head=HEX`` → {index, head} (the boundary a snapshot
-    joiner's runtime journals at activation)."""
-    fields = dict(
-        part.split("=", 1) for part in detail.split() if "=" in part
-    )
-    try:
-        return {"index": int(fields["index"]), "head": fields["head"]}
-    # hblint: disable=fault-swallowed-drop (accounted at the caller: a
-    # None return lands in sync_mismatches and flips the verdict to fork)
-    except (KeyError, ValueError):
-        return None
-
-
-def _parse_vid_note(detail: str) -> Optional[Dict[str, str]]:
-    """``root=HEX … payload_sha3=D`` → field dict (the runtime's VID
-    journal format: ``vid_cert`` notes from the proposer anchor the
-    payload digest behind a dispersed root; ``vid_retrieved`` notes from
-    every resolver must corroborate it)."""
-    fields = dict(
-        part.split("=", 1) for part in detail.split() if "=" in part
-    )
-    if "root" not in fields or "payload_sha3" not in fields:
-        return None
-    return fields
-
-
-def _digest(payload: bytes) -> str:
-    return hashlib.sha3_256(payload).hexdigest()[:16]
-
-
-# ===========================================================================
-# Equivocation slots
-# ===========================================================================
-
-
-def equivocation_key(msg: Any
-                     ) -> Optional[Tuple[Tuple, bytes, FaultKind]]:
-    """``(slot, value, FaultKind)`` for messages where one sender emitting
-    two *different* values for the same slot is proof of equivocation;
-    ``None`` for messages that may legitimately repeat with different
-    values (BVal/Aux vote for both sides honestly, EpochStarted
-    re-announces).  The slot includes everything that scopes the value;
-    the sender is supplied by the caller."""
-    from hbbft_tpu.protocols.binary_agreement import (
-        CoinMsg, ConfMsg, TermMsg,
-    )
-    from hbbft_tpu.protocols.broadcast import (
-        CanDecodeMsg, EchoHashMsg, EchoMsg, ReadyMsg, ValueMsg,
-    )
-    from hbbft_tpu.protocols.dynamic_honey_badger import HbWrap
-    from hbbft_tpu.protocols.honey_badger import (
-        DecryptionShareWrap, SubsetWrap,
-    )
-    from hbbft_tpu.protocols.sender_queue import AlgoMessage
-    from hbbft_tpu.protocols.subset import AgreementWrap, BroadcastWrap
-
-    era = 0
-    if isinstance(msg, AlgoMessage):
-        msg = msg.msg
-    if isinstance(msg, HbWrap):
-        era = msg.era
-        msg = msg.msg
-    if isinstance(msg, DecryptionShareWrap):
-        share = msg.msg.share
-        return ((era, msg.epoch, "decrypt", repr(msg.proposer_id)),
-                share.to_bytes(), FaultKind.MultipleDecryptionShares)
-    if not isinstance(msg, SubsetWrap):
-        return None
-    epoch = msg.epoch
-    inner = msg.msg
-    if isinstance(inner, BroadcastWrap):
-        proposer = repr(inner.proposer_id)
-        m = inner.msg
-        rules = (
-            (ValueMsg, "value", FaultKind.MultipleValues),
-            (EchoMsg, "echo", FaultKind.MultipleEchos),
-            (EchoHashMsg, "echo_hash", FaultKind.MultipleEchoHashes),
-            (CanDecodeMsg, "can_decode", FaultKind.MultipleCanDecodes),
-            (ReadyMsg, "ready", FaultKind.MultipleReadys),
-        )
-        for cls, tag, kind in rules:
-            if isinstance(m, cls):
-                root = m.proof.root_hash if isinstance(
-                    m, (ValueMsg, EchoMsg)) else m.root
-                return ((era, epoch, "rbc", proposer, tag), root, kind)
-        return None
-    if isinstance(inner, AgreementWrap):
-        proposer = repr(inner.proposer_id)
-        m = inner.msg
-        if isinstance(m, ConfMsg):
-            value = bytes([(False in m.values)
-                           | ((True in m.values) << 1)])
-            return ((era, epoch, "aba", proposer, "conf", m.epoch),
-                    value, FaultKind.MultipleConf)
-        if isinstance(m, TermMsg):
-            return ((era, epoch, "aba", proposer, "term"),
-                    b"\x01" if m.value else b"\x00",
-                    FaultKind.MultipleTerm)
-        if isinstance(m, CoinMsg):
-            inner_msg = m.msg
-            share = getattr(inner_msg, "share", None)
-            if share is not None:
-                return ((era, epoch, "aba", proposer, "coin", m.epoch),
-                        share.to_bytes(),
-                        FaultKind.MultipleSignatureShares)
-    return None
 
 
 # ===========================================================================
@@ -200,414 +65,23 @@ def equivocation_key(msg: Any
 # ===========================================================================
 
 
-@dataclass
-class Event:
-    """One timeline entry (sort-stable canonical key + display line)."""
-
-    era: int
-    epoch: int
-    rank: int
-    key: Tuple
-    line: str
-
-
-@dataclass
-class AuditResult:
-    nodes: List[str] = field(default_factory=list)
-    events: List[Event] = field(default_factory=list)
-    chains: Dict[str, Dict[str, Any]] = field(default_factory=dict)
-    first_divergence: Optional[Dict[str, Any]] = None
-    self_conflicts: List[Dict[str, Any]] = field(default_factory=list)
-    monotonicity_violations: List[Dict[str, Any]] = field(
-        default_factory=list)
-    equivocations: List[Dict[str, Any]] = field(default_factory=list)
-    unmatched_receives: int = 0
-    decode_failures: int = 0
-    torn_tails: int = 0
-    restarts: Dict[str, int] = field(default_factory=dict)
-    status_mismatches: List[str] = field(default_factory=list)
-    # membership lifecycle: nodes that activated from a state-sync
-    # snapshot (the journal's ``statesync`` note declares the claimed
-    # chain boundary), with the boundary verified against every other
-    # journal's digest at the preceding index
-    sync_joins: List[Dict[str, Any]] = field(default_factory=list)
-    sync_mismatches: List[str] = field(default_factory=list)
-    # conflicting slot values that attribute cleanly to DIFFERENT
-    # incarnations of the sender (its own journal shows each value sent
-    # exactly once, by a different process life): the expected amnesia
-    # artifact of a crash-restart without persistence re-proposing into
-    # already-decided epochs — reported, but not a fault verdict.  True
-    # equivocation (two values inside one incarnation, or a value the
-    # sender never journaled sending — the tampering shape) still is.
-    restart_reproposals: List[Dict[str, Any]] = field(
-        default_factory=list)
-    # VID cert-vs-retrieval corroboration: every ``vid_retrieved`` note's
-    # payload digest must agree with the proposer's ``vid_cert`` anchor
-    # and with every other resolver of the same root.  Two digests behind
-    # one committed root is a content fork — the ordered commitment was
-    # unambiguous but nodes read different payloads through it.
-    # Uncorroborated roots (proposer journal rotated, no retrieval yet)
-    # are benign and merely counted.
-    vid_roots: int = 0
-    vid_corroborated: int = 0
-    vid_inconsistencies: List[Dict[str, Any]] = field(
-        default_factory=list)
-    # resource-exhaustion forensics: journaled ``guard`` notes (ingress
-    # throttle escalations, SenderQueue backlog evictions, hello rejects
-    # — written by the runtime's overload defense) plus protocol-layer
-    # flood faults (FutureEpochFlood / SubsetMessageFlood), aggregated
-    # per OFFENDING peer so an incident attributes to the spamming node.
-    # Defense working as designed is not a fault verdict.
-    overload_incidents: List[Dict[str, Any]] = field(default_factory=list)
-
-    @property
-    def first_affected_epoch(self) -> Optional[Tuple[int, int]]:
-        keys = [(e["era"], e["epoch"]) for e in self.equivocations]
-        return min(keys) if keys else None
-
-    @property
-    def verdict(self) -> str:
-        if self.first_divergence or self.self_conflicts \
-                or self.status_mismatches or self.sync_mismatches \
-                or self.vid_inconsistencies:
-            return "fork"
-        if self.equivocations or self.monotonicity_violations:
-            return "fault"
-        return "clean"
-
-    def as_dict(self) -> Dict[str, Any]:
-        fa = self.first_affected_epoch
-        return {
-            "verdict": self.verdict,
-            "nodes": self.nodes,
-            "restarts": self.restarts,
-            "torn_tails": self.torn_tails,
-            "decode_failures": self.decode_failures,
-            "unmatched_receives": self.unmatched_receives,
-            "chains": {
-                n: {"head": c["head"], "len": c["len"]}
-                for n, c in self.chains.items()
-            },
-            "first_divergence": self.first_divergence,
-            "self_conflicts": self.self_conflicts,
-            "monotonicity_violations": self.monotonicity_violations,
-            "equivocations": self.equivocations,
-            "first_affected_epoch": list(fa) if fa else None,
-            "status_mismatches": self.status_mismatches,
-            "sync_joins": self.sync_joins,
-            "sync_mismatches": self.sync_mismatches,
-            "restart_reproposals": self.restart_reproposals,
-            "overload_incidents": self.overload_incidents,
-            "vid_roots": self.vid_roots,
-            "vid_corroborated": self.vid_corroborated,
-            "vid_inconsistencies": self.vid_inconsistencies,
-        }
-
-
 def audit(journals: List[Journal]) -> AuditResult:
-    """Merge journals, build the timeline, verify every invariant."""
-    res = AuditResult()
-    res.torn_tails = sum(j.torn_tails for j in journals)
-    res.nodes = [j.node for j in journals]
-    res.restarts = {j.node: max(0, j.starts - 1) for j in journals}
+    """Merge journals, build the timeline, verify every invariant.
 
-    # -- outbound index: sender node → payload digest → [(inc, rec)] ---------
-    out_index: Dict[str, Dict[str, List[Tuple[int, FlightMsg]]]] = {}
+    Thin batch wrapper over the incremental core: every record of every
+    journal is fed to an :class:`~hbbft_tpu.obs.audit_stream.
+    IncrementalAuditor` in journal order and the verdict is derived
+    once — byte-identical to the historical single-pass implementation
+    (regression-tested against the CLI output in test_obs_audit)."""
+    aud = IncrementalAuditor()
     for j in journals:
-        idx = out_index.setdefault(j.node, {})
+        aud.add_node(j.node)
+        for inc in j.incarnations:
+            aud.observe_incarnation(j.node, inc)
+        aud.add_torn(j.torn_tails)
         for inc, rec in j.records:
-            if isinstance(rec, FlightMsg) and rec.direction == "out" \
-                    and rec.payload:
-                idx.setdefault(_digest(rec.payload), []).append(
-                    (inc, rec))
-
-    # -- walk every record: timeline + commits + equivocation slots ----------
-    # slots[(sender, slot)] = {value_digest: sorted set of witness nodes}
-    slots: Dict[Tuple, Dict[str, Any]] = {}
-    # the sender's own account: per slot, which incarnation(s) journaled
-    # SENDING each value — what separates a crash-restart re-proposal
-    # from equivocation/tampering
-    slot_sends: Dict[Tuple, Dict[str, set]] = {}
-    commits: Dict[str, Dict[int, Tuple[str, int, int, int]]] = {}
-    # overload[peer] = {"kinds": {kind: count}, "witnesses": set}
-    overload: Dict[str, Dict[str, Any]] = {}
-    # vid[root] = {payload_sha3: {"cert:<node>" | "retr:<node>", ...}}
-    vid: Dict[str, Dict[str, set]] = {}
-    vid_anchored: set = set()  # roots with at least one vid_cert note
-
-    def _overload_hit(peer: str, kind: str, witness: str,
-                      claimed: Optional[str] = None) -> None:
-        entry = overload.setdefault(
-            peer, {"kinds": {}, "witnesses": set(), "claimed": set()})
-        entry["kinds"][kind] = entry["kinds"].get(kind, 0) + 1
-        entry["witnesses"].add(witness)
-        if claimed is not None:
-            entry["claimed"].add(claimed)
-
-    for j in journals:
-        node = j.node
-        per_index = commits.setdefault(node, {})
-        last_key: Dict[int, Tuple[int, int]] = {}  # inc → last (era, ep)
-        for inc, rec in j.records:
-            if isinstance(rec, FlightMsg):
-                d = _digest(rec.payload) if rec.payload else "-"
-                if rec.direction == "in":
-                    line = (f"era={rec.era} ep={rec.epoch} msg "
-                            f"{rec.mtype} {d} {rec.peer}->{node} "
-                            f"in@{node}#{inc}.{rec.seq}")
-                else:
-                    line = (f"era={rec.era} ep={rec.epoch} msg "
-                            f"{rec.mtype} {d} {node}->({rec.peer}) "
-                            f"out@{node}#{inc}.{rec.seq}")
-                res.events.append(Event(
-                    rec.era, rec.epoch, _RANK["msg"],
-                    (rec.mtype, d, 0 if rec.direction == "out" else 1,
-                     node, inc, rec.seq), line))
-                if rec.direction == "out" and rec.payload:
-                    # the sender's own account of what it emitted for
-                    # each equivocation slot, tagged with the process
-                    # incarnation that sent it
-                    try:
-                        msg = wire.decode_message(rec.payload)
-                    except (ValueError, TypeError):
-                        res.decode_failures += 1
-                        continue
-                    eq = equivocation_key(msg)
-                    if eq is not None:
-                        slot, value, kind = eq
-                        slot_sends.setdefault(
-                            (node, slot, kind), {}).setdefault(
-                            _digest(value), set()).add(inc)
-                if rec.direction != "in" or not rec.payload:
-                    continue
-                # match the receive to a journaled send
-                sender = rec.peer
-                if sender in out_index:
-                    outs = out_index[sender].get(d, ())
-                    if not any(target_covers(o.peer, node)
-                               for _i, o in outs):
-                        res.unmatched_receives += 1
-                # equivocation slots are receiver-side evidence
-                try:
-                    msg = wire.decode_message(rec.payload)
-                except (ValueError, TypeError):
-                    res.decode_failures += 1
-                    continue
-                eq = equivocation_key(msg)
-                if eq is not None:
-                    slot, value, kind = eq
-                    vals = slots.setdefault((sender, slot, kind), {})
-                    vals.setdefault(
-                        _digest(value), set()).add(node)
-            elif isinstance(rec, FlightCommit):
-                dig = rec.digest.hex()
-                res.events.append(Event(
-                    rec.era, rec.epoch, _RANK["commit"],
-                    ("commit", rec.index, node, inc, rec.seq),
-                    f"era={rec.era} ep={rec.epoch} commit "
-                    f"idx={rec.index} {dig[:16]} @{node}#{inc}"))
-                prev = per_index.get(rec.index)
-                if prev is not None and prev[0] != dig:
-                    res.self_conflicts.append({
-                        "node": node, "index": rec.index,
-                        "digests": sorted((prev[0][:16], dig[:16])),
-                    })
-                else:
-                    per_index[rec.index] = (dig, rec.era, rec.epoch,
-                                            inc)
-                last = last_key.get(inc)
-                if last is not None and (rec.era, rec.epoch) <= last:
-                    res.monotonicity_violations.append({
-                        "node": node, "incarnation": inc,
-                        "prev": list(last),
-                        "next": [rec.era, rec.epoch],
-                    })
-                last_key[inc] = (rec.era, rec.epoch)
-            elif isinstance(rec, FlightFault):
-                res.events.append(Event(
-                    rec.era, rec.epoch, _RANK["fault"],
-                    ("fault", rec.kind, rec.node, node, inc, rec.seq),
-                    f"era={rec.era} ep={rec.epoch} fault {rec.kind} "
-                    f"by {rec.node} seen@{node}#{inc}"))
-                if rec.kind in _OVERLOAD_FAULT_KINDS:
-                    _overload_hit(rec.node, rec.kind, node)
-            elif isinstance(rec, FlightSpan):
-                rnd = "-" if rec.round is None else rec.round
-                res.events.append(Event(
-                    rec.era, rec.epoch, _RANK["span"],
-                    ("span", rec.name, rnd, node, inc, rec.seq),
-                    f"era={rec.era} ep={rec.epoch} span {rec.name} "
-                    f"r={rnd} n={rec.count} @{node}#{inc}"))
-            elif isinstance(rec, FlightNote):
-                res.events.append(Event(
-                    0, 0, _RANK["note"],
-                    ("note", rec.kind, node, inc, rec.seq),
-                    f"note {rec.kind} {rec.detail} @{node}#{inc}"))
-                if rec.kind == "statesync":
-                    join = _parse_statesync_note(rec.detail)
-                    if join is None:
-                        res.sync_mismatches.append(
-                            f"{node}#{inc}: malformed statesync note "
-                            f"{rec.detail!r}")
-                    else:
-                        join.update({"node": node, "incarnation": inc})
-                        res.sync_joins.append(join)
-                elif rec.kind == "guard":
-                    hit = _parse_guard_note(rec.detail)
-                    if hit is not None:
-                        _overload_hit(hit["peer"], hit["kind"], node,
-                                      hit.get("claimed"))
-                elif rec.kind in ("vid_cert", "vid_retrieved"):
-                    fields = _parse_vid_note(rec.detail)
-                    if fields is None:
-                        res.vid_inconsistencies.append({
-                            "root": "?",
-                            "error": f"malformed {rec.kind} note "
-                                     f"{rec.detail!r} @{node}#{inc}",
-                        })
-                        continue
-                    sha3 = fields["payload_sha3"]
-                    if sha3 == "none":
-                        # failed retrieval — already surfaced through
-                        # the vid_mismatch/vid_exhausted notes and the
-                        # proposer fault; no digest to corroborate
-                        continue
-                    tag = ("cert" if rec.kind == "vid_cert"
-                           else "retr")
-                    vid.setdefault(fields["root"], {}).setdefault(
-                        sha3, set()).add(f"{tag}:{node}")
-                    if rec.kind == "vid_cert":
-                        vid_anchored.add(fields["root"])
-    res.events.sort(key=lambda e: (e.era, e.epoch, e.rank, e.key))
-    # resource-exhaustion attribution: most-implicated peer first
-    res.overload_incidents = [
-        {
-            "peer": peer,
-            "kinds": dict(sorted(entry["kinds"].items())),
-            "witnesses": sorted(entry["witnesses"]),
-            "events": sum(entry["kinds"].values()),
-            # spoof attribution: the identities this endpoint CLAIMED
-            # while failing authentication (distinct from "peer" — the
-            # impersonated validator is the victim, not the attacker)
-            **({"claimed_identities": sorted(entry["claimed"])}
-               if entry["claimed"] else {}),
-        }
-        for peer, entry in sorted(
-            overload.items(),
-            key=lambda kv: (-sum(kv[1]["kinds"].values()), kv[0]),
-        )
-    ]
-
-    # -- VID cert-vs-retrieval consistency -----------------------------------
-    # One root, one payload: the proposer's vid_cert digest and every
-    # resolver's vid_retrieved digest must be THE same sha3.  A root only
-    # counts as corroborated when at least two independent accounts
-    # agree (cert + a retrieval, or two retrievals); a lone account is
-    # benign but proves nothing.
-    res.vid_roots = len(vid)
-    for root in sorted(vid):
-        digests = vid[root]
-        if len(digests) > 1:
-            res.vid_inconsistencies.append({
-                "root": root,
-                "anchored": root in vid_anchored,
-                "digests": {d: sorted(w)
-                            for d, w in sorted(digests.items())},
-            })
-        elif sum(len(w) for w in digests.values()) >= 2:
-            res.vid_corroborated += 1
-
-    # -- digest-chain agreement ----------------------------------------------
-    for node, per_index in commits.items():
-        if per_index:
-            top = max(per_index)
-            res.chains[node] = {
-                "len": top + 1,
-                "head": per_index[top][0],
-                "commits": per_index,
-            }
-    all_indices = sorted({i for c in commits.values() for i in c})
-    for i in all_indices:
-        present = {n: c[i] for n, c in commits.items() if i in c}
-        if len({v[0] for v in present.values()}) > 1:
-            res.first_divergence = {
-                "index": i,
-                "per_node": {
-                    n: {"digest": v[0][:16], "era": v[1], "epoch": v[2]}
-                    for n, v in sorted(present.items())
-                },
-                "era": min(v[1] for v in present.values()),
-                "epoch": min(v[2] for v in present.values()),
-            }
-            break
-
-    # -- membership-lifecycle boundaries -------------------------------------
-    # A state-sync join claims "my chain starts at index k with head H".
-    # That claim must match what the rest of the cluster committed: any
-    # journal holding index k−1 must hold digest H there.  A joiner whose
-    # claimed boundary nobody can corroborate stays unverified (benign:
-    # donors' journals may have rotated past it); a CONTRADICTED boundary
-    # is a fork.
-    for join in res.sync_joins:
-        idx, head = join["index"], join["head"]
-        verified = None
-        for other, per_index in commits.items():
-            prev = per_index.get(idx - 1)
-            if prev is None:
-                continue
-            if prev[0] == head:
-                verified = other
-            else:
-                res.sync_mismatches.append(
-                    f"{join['node']} joined claiming chain[{idx - 1}] "
-                    f"= {head[:16]} but {other} committed "
-                    f"{prev[0][:16]} there")
-                verified = None
-                break
-        join["verified_against"] = verified
-
-    # -- equivocation evidence ----------------------------------------------
-    eq_kinds = equivocation_kinds()
-    for (sender, slot, kind), vals in sorted(
-            slots.items(), key=lambda kv: repr(kv[0])):
-        if len(vals) < 2:
-            continue
-        assert kind in eq_kinds
-        entry = {
-            "sender": sender,
-            "kind": kind.name,
-            "era": slot[0],
-            "epoch": slot[1],
-            "slot": repr(slot),
-            "values": {d: sorted(w) for d, w in sorted(vals.items())},
-        }
-        if _is_restart_reproposal(vals, slot_sends.get(
-                (sender, slot, kind))):
-            res.restart_reproposals.append(entry)
-        else:
-            res.equivocations.append(entry)
-    return res
-
-
-def _is_restart_reproposal(vals: Dict[str, Any],
-                           sent: Optional[Dict[str, set]]) -> bool:
-    """Do the conflicting values attribute cleanly to different process
-    incarnations of the sender?  Requires the sender's own journal to
-    show EVERY witnessed value being sent, each by exactly one
-    incarnation, all incarnations distinct — the amnesia shape of a
-    crash-restart re-proposing into already-decided epochs.  Anything
-    less (a value the sender never journaled — tampering; two values in
-    one incarnation — equivocation; rotated-away sender evidence) stays
-    slashing-grade."""
-    if sent is None:
-        return False
-    if set(vals) - set(sent):
-        return False
-    incs = [sent[d] for d in vals]
-    if any(len(s) != 1 for s in incs):
-        return False
-    flat = [next(iter(s)) for s in incs]
-    return len(set(flat)) == len(flat)
+            aud.feed(j.node, inc, rec)
+    return aud.result()
 
 
 def cross_check_status(res: AuditResult, doc: Dict[str, Any]) -> None:

@@ -167,8 +167,29 @@ class FlightNote:
     detail: str
 
 
+@dataclass(frozen=True)
+class HealthIncident:
+    """One classified live-health finding (the health plane's record).
+
+    Emitted by the watchtower (:mod:`hbbft_tpu.obs.watch`) into its own
+    journal, and by a node's runtime at local health transitions, so the
+    online detection trail is as durable and auditable as the protocol
+    evidence it points at.  ``key`` is the stable dedup identity: one
+    underlying fault yields ONE incident even across poll ticks, and a
+    replayed journal re-yields the identical key."""
+
+    seq: int
+    t: float
+    source: str          # who raised it: "watchtower" or a node id
+    kind: str            # classification: equivocation / straggler / …
+    severity: str        # "info" | "warn" | "fault" | "fork"
+    subject: str         # the implicated node / peer / rule subject
+    key: str             # stable dedup identity of the finding
+    detail: str
+
+
 RECORD_TYPES = (FlightHello, FlightMsg, FlightCommit, FlightFault,
-                FlightSpan, FlightNote, FlightTrace)
+                FlightSpan, FlightNote, FlightTrace, HealthIncident)
 
 
 def record_as_dict(rec: Any) -> Dict[str, Any]:
@@ -558,6 +579,17 @@ class FlightRecorder:
                                 detail))
         if kind in ("crash", "replay_gap"):
             self.flush()
+
+    def record_incident(self, kind: str, severity: str, subject: str,
+                        key: str, detail: str,
+                        t: Optional[float] = None) -> None:
+        """One classified health finding (see :class:`HealthIncident`);
+        flushed immediately — an incident is exactly the record an
+        operator reads the journal for after a crash."""
+        self._append(HealthIncident(self._next_seq(), self._now(t),
+                                    self.node, kind, severity, subject,
+                                    key, detail))
+        self.flush()
 
     # -- introspection -------------------------------------------------------
 

@@ -9,7 +9,10 @@ One :class:`ObsServer` per node serves:
 - ``GET /flight``  — the flight recorder's in-memory record tail as JSONL
   (payloads summarized as digest+size; the on-disk journal has the bytes);
 - ``GET /trace``   — the tail filtered to per-tx causal trace records
-  (``obs.trace``), tids in hex — grep a tid across nodes live.
+  (``obs.trace``), tids in hex — grep a tid across nodes live;
+- ``GET /health``  — the runtime's machine-readable health/headroom
+  document (status + per-lever headroom fractions; what the watchtower
+  polls and the future adaptive controller will consume).
 
 Deliberately tiny: request line + headers are read with a hard cap and a
 timeout, responses are ``Connection: close``, and anything but a known GET
@@ -40,12 +43,14 @@ class ObsServer:
     def __init__(self, registry, status_fn: Optional[Callable[[], dict]] = None,
                  spans_fn: Optional[Callable[[], str]] = None,
                  flight_fn: Optional[Callable[[], str]] = None,
-                 trace_fn: Optional[Callable[[], str]] = None):
+                 trace_fn: Optional[Callable[[], str]] = None,
+                 health_fn: Optional[Callable[[], dict]] = None):
         self.registry = registry
         self.status_fn = status_fn
         self.spans_fn = spans_fn
         self.flight_fn = flight_fn
         self.trace_fn = trace_fn
+        self.health_fn = health_fn
         self._c_dropped = registry.counter(
             "hbbft_obs_http_dropped_requests_total",
             "obs-endpoint requests dropped (malformed, timed out, or "
@@ -85,8 +90,12 @@ class ObsServer:
         if path == "/trace":
             body = self.trace_fn() if self.trace_fn is not None else ""
             return (200, "application/x-ndjson", body)
+        if path == "/health":
+            doc = self.health_fn() if self.health_fn is not None else {}
+            return (200, "application/json", json.dumps(doc))
         return (404, "text/plain; charset=utf-8",
-                "not found; try /metrics /status /spans /flight /trace\n")
+                "not found; try /metrics /status /spans /flight /trace "
+                "/health\n")
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:

@@ -45,7 +45,9 @@ TOP_PHASES = (
 
 def poll_target(host: str, port: int, timeout_s: float = 2.0
                 ) -> Optional[dict]:
-    """One node's ``{"status":…, "metrics":…}`` snapshot, None if down."""
+    """One node's ``{"status":…, "metrics":…, "health":…}`` snapshot,
+    None if down.  ``health`` is None (not a failure) on endpoints that
+    predate the ``/health`` route or serve an empty document."""
     try:
         status = http_get(host, port, "/status", timeout_s)
         metrics = http_get(host, port, "/metrics", timeout_s)
@@ -55,10 +57,19 @@ def poll_target(host: str, port: int, timeout_s: float = 2.0
         return None
     import json
 
+    health = None
+    try:
+        health = json.loads(http_get(host, port, "/health", timeout_s))
+    # hblint: disable=fault-swallowed-drop (benign: /health is optional
+    # — old endpoints and gateways render a "-" health cell, nothing
+    # is dropped)
+    except (OSError, ValueError):
+        health = None
     try:
         return {
             "status": json.loads(status),
             "metrics": parse_prometheus_text(metrics),
+            "health": health or None,
         }
     # hblint: disable=fault-swallowed-drop (same: unparseable responses
     # render the node as DOWN)
@@ -147,6 +158,7 @@ def render(targets: List[Target], prev: List[Optional[dict]],
         f"{'node':<22} {'era':>4} {'epoch':>6} {'batch':>6} "
         f"{'ep/s':>6} {'mempool':>8} {'peers':>5} {'txs':>8} "
         f"{'faults':>6} {'decode!':>7} {'gaps':>5} {'guard!':>6} "
+        f"{'degr':>4} {'vidp':>5} {'health':>8} "
         f"{'jrnl':>7} {'jseg':>4} {'jwf':>4} {'mesh':>6} "
         f"{'load':>8} {'shed':>5}"
     )
@@ -177,6 +189,11 @@ def render(targets: List[Target], prev: List[Optional[dict]],
         guard = (gi.get("throttles", 0) + gi.get("disconnects", 0)
                  + gd.get("senderq_evictions", 0)
                  + sum((gd.get("mempool_sheds") or {}).values()))
+        # adaptive-degradation level, lazy-retrieval backlog, and the
+        # node's own /health verdict — the live-health-plane columns
+        degr = (d.get("degraded") or {}).get("level", "-")
+        vidp = (d.get("vid") or {}).get("pending_retrievals", "-")
+        health = (snap.get("health") or {}).get("status", "-")
         # mesh-sharded epoch collectives (zero on single-device nodes)
         # and embedded-loadgen counters ("-" when no generator runs in
         # this process — hbbft_load_* lives in whichever registry hosts
@@ -194,6 +211,7 @@ def render(targets: List[Target], prev: List[Optional[dict]],
             f"{d['peers_connected']:>5} {d['committed_txs']:>8} "
             f"{d['faults_observed']:>6} {d['decode_failures']:>7} "
             f"{d['replay_gaps']:>5} {guard:>6} "
+            f"{degr:>4} {vidp:>5} {health:>8} "
             f"{jrnl:>7} {jseg:>4} {jwf:>4} {_i(mesh):>6} "
             f"{_i(load):>8} {_i(shed):>5}"
         )
@@ -222,10 +240,28 @@ def snapshot_doc(targets: List[Target],
         if snap is None:
             nodes.append({"target": f"{host}:{port}", "up": False})
             continue
+        d = snap["status"]
+        gd = d.get("guard") or {}
+        gi = gd.get("ingress") or {}
+        hd = snap.get("health") or {}
         nodes.append({
             "target": f"{host}:{port}",
             "up": True,
             "status": snap["status"],
+            # the explicit live-health-plane fields, same numbers the
+            # text view renders (guard!, degr, vidp, health columns) —
+            # scripts must not have to re-derive them from "status"
+            "guard": {
+                "throttles": gi.get("throttles", 0),
+                "disconnects": gi.get("disconnects", 0),
+                "senderq_evictions": gd.get("senderq_evictions", 0),
+                "mempool_sheds": sum(
+                    (gd.get("mempool_sheds") or {}).values()),
+            },
+            "degrade": d.get("degraded"),
+            "vid": d.get("vid"),
+            "health": hd.get("status"),
+            "headroom": hd.get("headroom"),
             "mesh_collectives": metric_total(
                 snap, "hbbft_mesh_collectives_total"),
             "mesh_gather_bytes": metric_total(
@@ -243,11 +279,22 @@ def snapshot_doc(targets: List[Target],
             gateways.append({"target": f"{host}:{port}", "up": False})
             continue
         drops = metric_total(snap, "hbbft_gw_client_drops_total")
+        s = snap["status"]
+        links = s.get("links") or []
         gateways.append({
             "target": f"{host}:{port}",
             "up": True,
             "status": snap["status"],
             "client_drops": None if drops is None else int(drops),
+            # the explicit gateway-tier fields the text table renders
+            "clients": s.get("clients", 0),
+            "pending": s.get("pending", 0),
+            "forward_queue": s.get("forward_queue", 0),
+            "links_up": sum(1 for li in links if li.get("connected")),
+            "links": len(links),
+            "sheds": s.get("sheds", 0),
+            "link_failovers": s.get("link_failovers", 0),
+            "health": (snap.get("health") or {}).get("status"),
         })
     pq = phase_quantiles(cur)
     doc = {

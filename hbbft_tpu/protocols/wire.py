@@ -587,6 +587,16 @@ def _lazy_register():
                          + blob(m.tids)),
               lambda r: FlightTrace(r.u64(), r.f64(), rs(r), r.u64(),
                                     r.u64(), r.u32(), rs(r), r.blob()))
+    # live health plane incident record (obs/flight.py, emitted by
+    # obs/watch.py and the runtime's local health hooks) ---------------------
+    from hbbft_tpu.obs.flight import HealthIncident
+
+    _register(0x96, HealthIncident,
+              lambda m: (u64(m.seq) + f64(m.t) + s(m.source) + s(m.kind)
+                         + s(m.severity) + s(m.subject) + s(m.key)
+                         + s(m.detail)),
+              lambda r: HealthIncident(r.u64(), r.f64(), rs(r), rs(r),
+                                       rs(r), rs(r), rs(r), rs(r)))
 
 
 def ensure_registered():

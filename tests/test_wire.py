@@ -192,7 +192,7 @@ def test_live_dhb_traffic_roundtrips():
 def _flight_samples():
     from hbbft_tpu.obs.flight import (
         FlightCommit, FlightFault, FlightHello, FlightMsg, FlightNote,
-        FlightSpan,
+        FlightSpan, HealthIncident,
     )
 
     return [
@@ -205,6 +205,9 @@ def _flight_samples():
         FlightSpan(11, 11.0, "aba_bval", 0, 3, 2, 1.5, 2.5, 12),
         FlightSpan(12, 12.0, "epoch", 0, 3, None, 1.0, 3.0, 60),
         FlightNote(13, 13.0, "replay_gap", "peer=3"),
+        HealthIncident(15, 15.0, "watchtower", "equivocation", "fault",
+                       "3", "equivocation:3:MultipleReadys:slot",
+                       "node 3 sent two Ready roots for one RBC slot"),
         _trace_sample(),
     ]
 
