@@ -1615,6 +1615,32 @@ def _net_run_once(epochs_target: int, n: int, batch_size: int,
                 json.loads(line) for line in body.splitlines() if line
             )
         net["phases"] = _net_phase_summary(span_dicts)
+        # per-segment pump cost over the whole run, summed across the
+        # cluster (the perf plane's bench surface): --compare's
+        # pump[<segment>].mean_s gate and --freeze-perf-profile both
+        # read this
+        from hbbft_tpu.obs.metrics import parse_prometheus_text
+        from hbbft_tpu.obs.perf import segment_means
+
+        pump = {}
+        for nid in range(n):
+            host, mport = cfg.metrics_addr(nid)
+            try:
+                parsed = parse_prometheus_text(
+                    http_get(host, mport, "/metrics", timeout_s=5.0))
+            except (OSError, ValueError) as exc:
+                print(f"# metrics fetch from node {nid} failed: "
+                      f"{exc!r}", file=sys.stderr)
+                continue
+            for seg, m in segment_means(parsed).items():
+                acc = pump.setdefault(seg, {"busy_s": 0.0, "events": 0})
+                acc["busy_s"] += m["busy_s"]
+                acc["events"] += int(m["events"])
+        for acc in pump.values():
+            acc["mean_s"] = (round(acc["busy_s"] / acc["events"], 9)
+                             if acc["events"] else 0.0)
+            acc["busy_s"] = round(acc["busy_s"], 6)
+        net["pump_util"] = pump
     finally:
         if watch_stop is not None:
             watch_stop.set()
@@ -1952,6 +1978,7 @@ def net_cluster_bench(epochs_target: int = 20, n: int = 4,
         "sim_baseline_epochs": sim_epochs,
         "phases": best["phases"],
         "transport": best["transport"],
+        "pump_util": best.get("pump_util"),
     }
     if "watch" in best:
         line["watch"] = best["watch"]
@@ -2074,6 +2101,43 @@ def vid_dispersal_bench(epochs_target: int = 6, n: int = 4,
     print(json.dumps(line), flush=True)
 
 
+def freeze_perf_profile(epochs_target: int = 10, n: int = 4,
+                        batch_size: int = 8, tx_size: int = 64,
+                        out_name: str = "PERF_PROFILE.json"):
+    """Freeze the same-host per-segment pump cost profile
+    (``--freeze-perf-profile``): one short ``--net``-shaped cluster
+    run, per-segment mean costs summed across the cluster, written to
+    ``PERF_PROFILE.json`` — the baseline the watchtower's perf-drift
+    sentinel (``obs.watch --perf-profile``) compares live scrape
+    deltas against.  Same-host rule as every frozen number: re-freeze
+    after a hardware change, never compare against another box's
+    profile."""
+    import datetime
+
+    run = _net_run_once(epochs_target, n, batch_size, tx_size,
+                        pipeline_depth=1, tag="perf-profile")
+    segments = run.get("pump_util") or {}
+    line = {
+        "metric": "perf_profile",
+        "value": len(segments),
+        "unit": "segments",
+        "vs_baseline": 1.0,
+        "shape": f"N={n} f={(n - 1) // 3} batch={batch_size} "
+                 f"tx={tx_size}B depth=1",
+        "epochs": run["epochs"],
+        "epochs_per_s": run["epochs_per_s"],
+        "measured_utc": datetime.datetime.utcnow().strftime(
+            "%Y-%m-%dT%H:%M:%SZ"),
+        "segments": segments,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        out_name)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(line, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps(line), flush=True)
+
+
 # ===========================================================================
 # --compare: regression gate over two recorded bench JSON lines
 # ===========================================================================
@@ -2172,6 +2236,29 @@ def compare_bench(old, new, threshold: float = 0.15,
         add("phases.epoch_wall_p99_ms", False, threshold)
         for group in ("rbc", "aba", "coin", "decrypt"):
             add(f"phases.{group}.attr_p50_ms", False, phase_threshold)
+        # performance plane: per-segment pump mean cost is lower-better,
+        # equal-shape rule like the ingest cells (a segment present in
+        # only one recording contributes nothing) and equal-depth only
+        # like the phase attribution above (a deeper pipeline
+        # legitimately changes per-iteration work); gated at
+        # phase_threshold — segment means are attribution-grade noisy
+        old_pu, new_pu = (old.get("pump_util") or {},
+                          new.get("pump_util") or {})
+        for seg in sorted(k for k in old_pu if k in new_pu):
+            o = (old_pu[seg] or {}).get("mean_s")
+            nv = (new_pu[seg] or {}).get("mean_s")
+            if not isinstance(o, (int, float)) \
+                    or not isinstance(nv, (int, float)) or o <= 0:
+                continue
+            delta = (nv - o) / o
+            checks.append({
+                "name": f"pump[{seg}].mean_s",
+                "old": o,
+                "new": nv,
+                "delta_pct": round(100 * delta, 2),
+                "threshold_pct": round(100 * phase_threshold, 2),
+                "regressed": delta > phase_threshold,
+            })
     # ingestion sweep: tx/s and MB/s are higher-better rates gated ONLY
     # at equal (tx_bytes, batch) shape — a recording that adds, drops,
     # or resizes cells contributes nothing to the verdict for the
@@ -2421,6 +2508,14 @@ def main(argv=None):
         "denominators (host-only; no device work)",
     )
     ap.add_argument(
+        "--freeze-perf-profile", type=int, nargs="?", const=10,
+        default=0, metavar="EPOCHS",
+        help="freeze the same-host per-segment pump cost profile (one "
+             "short localhost cluster run) into PERF_PROFILE.json — "
+             "the watchtower perf-drift sentinel's baseline "
+             "(python -m hbbft_tpu.obs.watch --perf-profile)",
+    )
+    ap.add_argument(
         "--compare", nargs=2, metavar=("OLD.json", "NEW.json"),
         help="regression gate: compare two recorded bench JSON lines "
              "(epochs/s, latency p50/p99, per-phase attribution) and "
@@ -2439,6 +2534,10 @@ def main(argv=None):
 
     if args.freeze_baselines:
         freeze_baselines()
+        return
+
+    if args.freeze_perf_profile:
+        freeze_perf_profile(epochs_target=args.freeze_perf_profile)
         return
 
     if args.vid:

@@ -166,9 +166,12 @@ class BoundedIngressChecker(Checker):
     # obs/audit_stream.py and obs/watch.py joined with the live health
     # plane: both consume unbounded external input (journal bytes,
     # scraped endpoints) in long-running processes, so their state must
-    # show the same bounding evidence as the network ingress paths
+    # show the same bounding evidence as the network ingress paths;
+    # obs/perf.py samples forever in-process (its ring deques must stay
+    # bounded the same way)
     scope = ("hbbft_tpu/net/", "hbbft_tpu/protocols/",
-             "hbbft_tpu/obs/audit_stream.py", "hbbft_tpu/obs/watch.py")
+             "hbbft_tpu/obs/audit_stream.py", "hbbft_tpu/obs/watch.py",
+             "hbbft_tpu/obs/perf.py")
     rules = {
         "bounded-ingress":
             "a self.* collection grown from network-derived input in "

@@ -142,11 +142,15 @@ class DeterminismChecker(Checker):
     # Watchtower.tick(now, ...) takes the caller's clock (virtual in sim
     # cells, scripted in tests); only the CLI loop reads wall time,
     # under justified suppressions
+    # obs/perf.py holds the performance plane to the same contract:
+    # sample(now)/segment_means are pure folds over counter snapshots;
+    # the single wall-clock read lives in maybe_sample under a
+    # justified suppression
     scope = ("hbbft_tpu/protocols/", "hbbft_tpu/parallel/",
              "hbbft_tpu/crypto/", "hbbft_tpu/chaos/",
              "hbbft_tpu/ops/rs.py", "hbbft_tpu/obs/trace.py",
              "hbbft_tpu/net/retrieve.py", "hbbft_tpu/obs/audit_stream.py",
-             "hbbft_tpu/obs/watch.py")
+             "hbbft_tpu/obs/watch.py", "hbbft_tpu/obs/perf.py")
     rules = {
         "det-wall-clock":
             "wall-clock read in consensus-core code (time.time, "

@@ -25,7 +25,7 @@ from hbbft_tpu.lint.core import Checker, Finding, Project, register
 
 NAME_CONVENTION = re.compile(
     r"^hbbft_(net|node|phase|sim|obs|chaos|sync|guard|rbc|load|mesh"
-    r"|pump|trace|gw|vid|health)"
+    r"|pump|trace|gw|vid|health|perf|ctrl)"
     r"_[a-z][a-z0-9_]*$"
 )
 

@@ -137,6 +137,16 @@ class ClusterConfig:
     # proposed batch size / mempool admission under sustained guard
     # pressure instead of riding the buffers into their cliff-edge caps
     degrade: bool = True
+    # the controller's raise arm (opt-in): under sustained benign slack
+    # (perf-plane headroom + real demand) raise batch size / mempool
+    # admission up to this many doubling boosts toward the 8x ceilings;
+    # 0 keeps the ladder degrade-only (chaos verdicts unchanged)
+    max_boost: int = 0
+    # raise-arm tuning (only consulted when max_boost > 0): clean
+    # windows per boost step and the headroom floor that counts as
+    # slack — a loaded shared box may never see the 0.6 default
+    raise_windows: int = 10
+    raise_headroom: float = 0.6
     # class-selective shaping: the listed nodes ("0,1") hold their
     # outbound BINARY-AGREEMENT traffic (BVal/Aux/Conf/Coin/Term) for
     # `aba_out_delay_s` while RBC flows normally.  Decorrelating ABA
@@ -344,6 +354,10 @@ def _shared_runtime_kwargs(cfg: ClusterConfig, nid: int) -> dict:
         auth=cfg.auth,
         auth_grace_s=cfg.auth_grace_s,
         degrade=cfg.degrade,
+        degrade_kwargs=(dict(max_boost=cfg.max_boost,
+                             raise_windows=cfg.raise_windows,
+                             raise_headroom=cfg.raise_headroom)
+                        if cfg.max_boost > 0 else None),
     )
 
 
@@ -683,6 +697,12 @@ def node_command(cfg: ClusterConfig, nid: int) -> List[str]:
         cmd += ["--auth-grace-s", str(cfg.auth_grace_s)]
     if not cfg.degrade:
         cmd.append("--no-degrade")
+    if cfg.max_boost > 0:
+        cmd += ["--max-boost", str(cfg.max_boost)]
+        if cfg.raise_windows != 10:
+            cmd += ["--raise-windows", str(cfg.raise_windows)]
+        if cfg.raise_headroom != 0.6:
+            cmd += ["--raise-headroom", str(cfg.raise_headroom)]
     if cfg.step_delay_for(nid) > 0:
         cmd += ["--step-delay", str(cfg.step_delay_for(nid))]
     if cfg.aba_delay_for(nid) > 0:
@@ -948,6 +968,17 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="disable guard-driven adaptive degradation "
                          "(batch-size/mempool shrink under sustained "
                          "overload)")
+    ap.add_argument("--max-boost", type=int, default=0,
+                    help="arm the controller's raise side: up to this "
+                         "many batch-size/mempool doublings under "
+                         "sustained measured headroom (0 = degrade-"
+                         "only ladder)")
+    ap.add_argument("--raise-windows", type=int, default=10,
+                    help="clean windows of slack+demand per boost step "
+                         "(with --max-boost)")
+    ap.add_argument("--raise-headroom", type=float, default=0.6,
+                    help="measured headroom floor that counts as slack "
+                         "(with --max-boost)")
     ap.add_argument("--join", action="store_true",
                     help="join a LIVE cluster via snapshot state-sync "
                          "instead of starting from genesis: the "
@@ -978,6 +1009,9 @@ def main(argv: Optional[List[str]] = None) -> None:
         auth=not args.no_auth,
         auth_grace_s=args.auth_grace_s,
         degrade=not args.no_degrade,
+        max_boost=args.max_boost,
+        raise_windows=args.raise_windows,
+        raise_headroom=args.raise_headroom,
     )
     if args.join:
         asyncio.run(run_join_node(cfg, args.node_id,

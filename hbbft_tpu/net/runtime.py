@@ -61,6 +61,7 @@ from hbbft_tpu.snapshot import capture_join_snapshot
 from hbbft_tpu.obs.flight import FlightObserver, FlightRecorder
 from hbbft_tpu.obs.http import ObsServer
 from hbbft_tpu.obs.metrics import MetricAttr, Registry, fault_counter
+from hbbft_tpu.obs.perf import PerfPlane
 from hbbft_tpu.obs.spans import SpanTracer
 from hbbft_tpu.obs.trace import trace_id
 from hbbft_tpu.ops import rs as _rs
@@ -254,12 +255,12 @@ class NodeRuntime:
             c.strip() for c in aba_out_classes.split(",") if c.strip()
         )
         # tick_s: the degradation controller needs periodic pump wakes
-        # to recover on an idle node (see StepPump), and VID retrieval
-        # retries need the same heartbeat; without either the pump stays
-        # purely event-driven
+        # to recover on an idle node (see StepPump), VID retrieval
+        # retries need the same heartbeat, and the always-on perf plane
+        # samples on it (a stalled sampler would freeze /status headroom
+        # exactly when an operator looks)
         self.pump = StepPump(self, pipeline_depth=self.pipeline_depth,
-                             tick_s=0.25 if (degrade or self._vid)
-                             else None)
+                             tick_s=0.25)
         self._out: Optional[_PumpOutcome] = None
         # park threshold-decrypt share verification in the protocols so
         # the pump can resolve ALL in-flight epochs' sets in one merged
@@ -528,9 +529,23 @@ class NodeRuntime:
             self._pump_record = open(
                 os.path.join(rec_dir,
                              f"events-{self.sq.our_id()!r}.jsonl"), "w")
+        # performance plane (obs/perf.py): always-on counter-snapshot
+        # profiler + headroom model, sampled on the pump heartbeat
+        # (pump_tick) and served at /perf.  Built BEFORE the controller
+        # so the degradation ladder's raise arm can consume its measured
+        # headroom as the slack signal.
+        self.perf = PerfPlane(
+            self.registry, self.sq.our_id(),
+            pump_cpu_fn=lambda: self.pump.cpu_seconds,
+            pump_stats_fn=lambda: (self.pump.iterations,
+                                   self.pump.offloaded),
+            record=(self.flight.recorder.record_perf
+                    if self.flight is not None else None))
         # guard-driven adaptive degradation (net/degrade.py): shrink the
         # proposed batch size and mempool admission under sustained
-        # guard pressure, restore when it clears.  None when the wrapped
+        # guard pressure, restore when it clears — and, with a perf
+        # plane measuring slack, raise toward the configured ceilings
+        # under sustained benign headroom.  None when the wrapped
         # protocol exposes no batch size (nothing to degrade) or
         # degrade=False.
         self.degrade = (_attach_degrade(self, **(degrade_kwargs or {}))
@@ -623,6 +638,9 @@ class NodeRuntime:
         recovery both proceed whether the node is busy or idle.  VID
         retrieval retries are enqueued as a pump event rather than run
         here — the tick has no _PumpOutcome to absorb Steps into."""
+        # sample the perf plane FIRST: the controller's raise arm reads
+        # the headroom this tick just measured, not last tick's
+        self.perf.maybe_sample()
         if self.degrade is not None:
             self.degrade.tick()
         if self._retrieve is not None and self._retrieve.pending_count():
@@ -891,7 +909,7 @@ class NodeRuntime:
     async def start_obs(self, host: str = "127.0.0.1",
                         port: int = 0) -> Addr:
         """Serve ``/metrics``, ``/status``, ``/spans``, ``/flight``,
-        ``/trace``, ``/health`` (see obs.http)."""
+        ``/trace``, ``/health``, ``/perf`` (see obs.http)."""
         self._obs_server = ObsServer(
             self.registry,
             status_fn=self.status_doc,
@@ -901,6 +919,7 @@ class NodeRuntime:
             trace_fn=(self.flight.recorder.trace_jsonl
                       if self.flight is not None else None),
             health_fn=self.health_doc,
+            perf_fn=self.perf.perf_doc,
         )
         self.obs_addr = await self._obs_server.start(host, port)
         return self.obs_addr
@@ -1053,7 +1072,11 @@ class NodeRuntime:
                         self._absorb(self._retrieve.tick(time.time()))
                     else:  # pragma: no cover - enqueue() callers are local
                         raise ValueError(f"unknown pump event {kind!r}")
-                    segs[kind] = segs.get(kind, 0.0) + (pc() - t0)
+                    # batch-handle events ("msgs") are the same dispatch
+                    # work as "msg" — fold them into one segment so the
+                    # hot path stays visible to the perf plane
+                    sk = "msg" if kind == "msgs" else kind
+                    segs[sk] = segs.get(sk, 0.0) + (pc() - t0)
                 t0 = pc()
                 self._drain_deferred()
                 if depth > 1:
@@ -1126,7 +1149,8 @@ class NodeRuntime:
                 raise ValueError(f"unknown pump event {kind!r}")
             timing[kind] = timing.get(kind, 0.0) + (tt() - t0)
             timing["n_" + kind] = timing.get("n_" + kind, 0.0) + 1
-            segs[kind] = segs.get(kind, 0.0) + (pc() - w0)
+            sk = "msg" if kind == "msgs" else kind
+            segs[sk] = segs.get(sk, 0.0) + (pc() - w0)
         t0 = tt()
         w0 = pc()
         self._drain_deferred()
@@ -1867,6 +1891,10 @@ class NodeRuntime:
             },
             "degraded": (self.degrade.as_dict()
                          if self.degrade is not None else None),
+            # the perf plane's compact view: the single headroom scalar
+            # plus per-layer utilization (full doc at /perf)
+            "perf": self.perf.summary(),
+            "headroom": self.perf.headroom(),
             "vid": (
                 {
                     "pending_retrievals": self._retrieve.pending_count(),
@@ -1932,6 +1960,11 @@ class NodeRuntime:
                 "vid_pending": (self._retrieve.pending_count()
                                 if self._retrieve is not None else 0),
             },
+            # the perf plane's measured slack scalar (None before the
+            # first complete sampling window) + per-layer utilization —
+            # what the controller's raise arm actually consumes
+            "perf_headroom": self.perf.headroom(),
+            "util": self.perf.utilization(),
             "degrade": (self.degrade.as_dict()
                         if self.degrade is not None else None),
             "guard": {

@@ -103,6 +103,10 @@ class StepPump:
         self.error: Optional[BaseException] = None
         self.iterations = 0
         self.offloaded = 0
+        #: cumulative pump CPU seconds (thread time summed across
+        #: iterations, inline or offloaded) — the perf plane's
+        #: pump-layer CPU source, sampled by counter snapshot
+        self.cpu_seconds = 0.0
         # EWMA of recent iteration cost drives the inline-vs-executor
         # decision; it starts cheap (inline) and a single expensive
         # iteration (first pairing burst) flips it within a few rounds
@@ -194,6 +198,7 @@ class StepPump:
                     self._cost_ewma = (
                         0.7 * self._cost_ewma + 0.3 * outcome.cpu_s
                     )
+                    self.cpu_seconds += outcome.cpu_s
                     self.iterations += 1
                     self.runtime.pump_flush(outcome)
                 if tick is not None:

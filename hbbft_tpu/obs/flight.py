@@ -188,8 +188,28 @@ class HealthIncident:
     detail: str
 
 
+@dataclass(frozen=True)
+class PerfSnapshot:
+    """One performance-plane sampling window (the perf plane's record).
+
+    Journaled every N-th sample by :class:`hbbft_tpu.obs.perf.PerfPlane`
+    so post-hoc forensics can line capacity history up against the
+    fault/commit timeline.  ``doc`` is the JSON-encoded per-layer
+    utilization + per-segment breakdown (a string, not a dict: flight
+    records must stay hashable for the wire-completeness contract)."""
+
+    seq: int
+    t: float
+    source: str          # the sampling node (recorder identity)
+    window_s: float      # wall seconds covered by this window
+    cpu_frac: float      # whole-process CPU fraction over the window
+    headroom: float      # 1 - max layer utilization (the slack scalar)
+    doc: str             # JSON: {"layers": {...}, "segments": {...}}
+
+
 RECORD_TYPES = (FlightHello, FlightMsg, FlightCommit, FlightFault,
-                FlightSpan, FlightNote, FlightTrace, HealthIncident)
+                FlightSpan, FlightNote, FlightTrace, HealthIncident,
+                PerfSnapshot)
 
 
 def record_as_dict(rec: Any) -> Dict[str, Any]:
@@ -590,6 +610,16 @@ class FlightRecorder:
                                     self.node, kind, severity, subject,
                                     key, detail))
         self.flush()
+
+    def record_perf(self, window_s: float, cpu_frac: float,
+                    headroom: float, doc: str,
+                    t: Optional[float] = None) -> None:
+        """One perf-plane sampling window (see :class:`PerfSnapshot`);
+        not flushed eagerly — perf history is valuable but never worth a
+        sync on the pump path (crash flush picks up the tail)."""
+        self._append(PerfSnapshot(self._next_seq(), self._now(t),
+                                  self.node, float(window_s),
+                                  float(cpu_frac), float(headroom), doc))
 
     # -- introspection -------------------------------------------------------
 

@@ -12,7 +12,11 @@ One :class:`ObsServer` per node serves:
   (``obs.trace``), tids in hex — grep a tid across nodes live;
 - ``GET /health``  — the runtime's machine-readable health/headroom
   document (status + per-lever headroom fractions; what the watchtower
-  polls and the future adaptive controller will consume).
+  polls and the future adaptive controller will consume);
+- ``GET /perf``    — the performance plane's flame-style profile +
+  headroom document (``obs.perf.PerfPlane.perf_doc``): per-layer
+  utilization, per-segment busy time over the retained window, and the
+  raw sampling-window series.
 
 Deliberately tiny: request line + headers are read with a hard cap and a
 timeout, responses are ``Connection: close``, and anything but a known GET
@@ -44,13 +48,15 @@ class ObsServer:
                  spans_fn: Optional[Callable[[], str]] = None,
                  flight_fn: Optional[Callable[[], str]] = None,
                  trace_fn: Optional[Callable[[], str]] = None,
-                 health_fn: Optional[Callable[[], dict]] = None):
+                 health_fn: Optional[Callable[[], dict]] = None,
+                 perf_fn: Optional[Callable[[], dict]] = None):
         self.registry = registry
         self.status_fn = status_fn
         self.spans_fn = spans_fn
         self.flight_fn = flight_fn
         self.trace_fn = trace_fn
         self.health_fn = health_fn
+        self.perf_fn = perf_fn
         self._c_dropped = registry.counter(
             "hbbft_obs_http_dropped_requests_total",
             "obs-endpoint requests dropped (malformed, timed out, or "
@@ -93,9 +99,12 @@ class ObsServer:
         if path == "/health":
             doc = self.health_fn() if self.health_fn is not None else {}
             return (200, "application/json", json.dumps(doc))
+        if path == "/perf":
+            doc = self.perf_fn() if self.perf_fn is not None else {}
+            return (200, "application/json", json.dumps(doc))
         return (404, "text/plain; charset=utf-8",
                 "not found; try /metrics /status /spans /flight /trace "
-                "/health\n")
+                "/health /perf\n")
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:

@@ -3,9 +3,13 @@
 Polls every node's obs endpoint (``/status`` + ``/metrics``), and renders a
 refreshing plain-ANSI table: per-node era/epoch/batches, live epoch rate
 (batches delta over the poll interval), mempool depth, connected peers,
-fault and decode counters — plus the cluster-aggregated per-phase p50/p99
-(from the ``hbbft_phase_duration_seconds`` histograms, buckets summed
-across nodes), which is the "where does the epoch latency go" line.
+fault and decode counters, the performance plane's ``util%`` (worst
+per-layer utilization, i.e. ``100·(1 − headroom)``) and the
+bidirectional controller's ``ctrl`` state (``+N`` degraded N levels,
+``-N`` raised N boosts, ``0`` at exact bases) — plus the
+cluster-aggregated per-phase p50/p99 (from the
+``hbbft_phase_duration_seconds`` histograms, buckets summed across
+nodes), which is the "where does the epoch latency go" line.
 
     python -m hbbft_tpu.obs.top --targets 127.0.0.1:26000,127.0.0.1:26001
     python -m hbbft_tpu.obs.top --base-port 26000 --nodes 4
@@ -112,6 +116,40 @@ def phase_quantiles(snaps: List[Optional[dict]],
     return out
 
 
+def util_cell(status: dict) -> Tuple[str, Optional[float]]:
+    """(table cell, percent) for the perf plane's utilization: the
+    worst per-layer busy fraction as ``100·(1 − headroom)`` — "-" until
+    the node's sampler has completed its first window."""
+    headroom = (status.get("perf") or {}).get("headroom")
+    if headroom is None:
+        headroom = status.get("headroom")
+    if headroom is None:
+        return "-", None
+    pct = max(0.0, min(100.0, (1.0 - float(headroom)) * 100.0))
+    return f"{pct:.0f}", pct
+
+
+def ctrl_summary(status: dict) -> Tuple[str, Optional[dict]]:
+    """(table cell, JSON doc) for the bidirectional controller:
+    effective level (``+N`` = degraded N levels, ``-N`` = raised N
+    boosts, ``0`` = exact bases) plus current/base proposer batch
+    size.  "-" on nodes without a controller."""
+    dg = status.get("degraded") or {}
+    if "level" not in dg:
+        return "-", None
+    level = int(dg.get("level") or 0)
+    boost = int(dg.get("boost") or 0)
+    effective = level - boost
+    doc = {
+        "level": level,
+        "boost": boost,
+        "effective": effective,
+        "batch_size": dg.get("batch_size"),
+        "base_batch_size": dg.get("base_batch_size"),
+    }
+    return (f"{effective:+d}" if effective else "0"), doc
+
+
 def render_gateways(gw_targets: List[Target],
                     gw_cur: List[Optional[dict]]) -> List[str]:
     """The gateway-tier table (empty list when no gateways polled)."""
@@ -158,7 +196,8 @@ def render(targets: List[Target], prev: List[Optional[dict]],
         f"{'node':<22} {'era':>4} {'epoch':>6} {'batch':>6} "
         f"{'ep/s':>6} {'mempool':>8} {'peers':>5} {'txs':>8} "
         f"{'faults':>6} {'decode!':>7} {'gaps':>5} {'guard!':>6} "
-        f"{'degr':>4} {'vidp':>5} {'health':>8} "
+        f"{'degr':>4} {'util%':>5} {'ctrl':>4} {'vidp':>5} "
+        f"{'health':>8} "
         f"{'jrnl':>7} {'jseg':>4} {'jwf':>4} {'mesh':>6} "
         f"{'load':>8} {'shed':>5}"
     )
@@ -192,6 +231,11 @@ def render(targets: List[Target], prev: List[Optional[dict]],
         # adaptive-degradation level, lazy-retrieval backlog, and the
         # node's own /health verdict — the live-health-plane columns
         degr = (d.get("degraded") or {}).get("level", "-")
+        # perf-plane utilization and bidirectional-controller columns:
+        # util% is the worst layer's busy fraction, ctrl the signed
+        # effective level (+degrade / -raise / 0 at bases)
+        util, _ = util_cell(d)
+        ctrl, _ = ctrl_summary(d)
         vidp = (d.get("vid") or {}).get("pending_retrievals", "-")
         health = (snap.get("health") or {}).get("status", "-")
         # mesh-sharded epoch collectives (zero on single-device nodes)
@@ -211,7 +255,7 @@ def render(targets: List[Target], prev: List[Optional[dict]],
             f"{d['peers_connected']:>5} {d['committed_txs']:>8} "
             f"{d['faults_observed']:>6} {d['decode_failures']:>7} "
             f"{d['replay_gaps']:>5} {guard:>6} "
-            f"{degr:>4} {vidp:>5} {health:>8} "
+            f"{degr:>4} {util:>5} {ctrl:>4} {vidp:>5} {health:>8} "
             f"{jrnl:>7} {jseg:>4} {jwf:>4} {_i(mesh):>6} "
             f"{_i(load):>8} {_i(shed):>5}"
         )
@@ -259,6 +303,11 @@ def snapshot_doc(targets: List[Target],
                     (gd.get("mempool_sheds") or {}).values()),
             },
             "degrade": d.get("degraded"),
+            # the performance-plane / controller fields the text view
+            # renders as util% and ctrl
+            "perf": d.get("perf"),
+            "util_pct": util_cell(d)[1],
+            "ctrl": ctrl_summary(d)[1],
             "vid": d.get("vid"),
             "health": hd.get("status"),
             "headroom": hd.get("headroom"),

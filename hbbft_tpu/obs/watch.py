@@ -19,6 +19,18 @@ series, and turns the raw surfaces into **classified health incidents**:
   for ``engage_ticks`` consecutive ticks to alarm and must clear for
   ``clear_ticks`` ticks to re-arm — so a flapping signal cannot
   alarm-storm.  Each engagement episode raises exactly one incident.
+- **perf-drift incidents** (the performance sentinel): given a frozen
+  same-host profile (``bench.py --freeze-perf-profile`` →
+  ``--perf-profile PATH``), every tick computes each node's live
+  per-segment mean cost from the *delta* between consecutive
+  ``/metrics`` scrapes (:func:`hbbft_tpu.obs.perf.segment_means`) and
+  compares it against the profile.  The worst live/profile mean ratio
+  is the ``perf_drift_ratio`` signal; a built-in
+  ``perf_drift_ratio<=perf_ratio`` rule rides the same hysteresis +
+  episode machinery and raises ``perf_regression`` incidents — a hot
+  path that got 2× slower alarms online, a noisy single window does
+  not (segments below ``perf_min_events`` events per window are
+  ignored).
 
 SLO rule syntax (``--slo``, repeatable): ``signal<=limit`` or
 ``signal>=limit``, e.g. ``--slo "epochs_per_s>=0.5"`` (cluster floor),
@@ -66,6 +78,7 @@ from hbbft_tpu.obs.http import http_get
 from hbbft_tpu.obs.metrics import (
     Registry, histogram_quantile, parse_prometheus_text,
 )
+from hbbft_tpu.obs.perf import segment_means
 
 Target = Tuple[str, int]
 
@@ -103,8 +116,29 @@ class SloRule:
 #: else is cluster-scoped (subject = "cluster")
 NODE_SIGNALS = frozenset({
     "epoch_lag", "mempool_frac", "pump_backlog_frac", "vid_pending",
-    "degrade_active",
+    "degrade_active", "perf_drift_ratio",
 })
+
+
+def normalize_perf_profile(doc: Any) -> Dict[str, float]:
+    """Accept either a frozen-profile document (``bench.py
+    --freeze-perf-profile``: ``{"segments": {seg: {"mean_s": …}}}``) or
+    a flat ``{segment: mean_s}`` mapping; return the flat form with
+    non-positive baselines dropped (a zero baseline cannot anchor a
+    ratio)."""
+    segs = doc.get("segments", doc) if isinstance(doc, dict) else {}
+    out: Dict[str, float] = {}
+    for seg, v in segs.items():
+        mean = v.get("mean_s") if isinstance(v, dict) else v
+        try:
+            mean = float(mean)
+        # hblint: disable=fault-swallowed-drop (config parsing: a
+        # malformed profile entry is skipped, not an ingress drop)
+        except (TypeError, ValueError):
+            continue
+        if mean > 0:
+            out[str(seg)] = mean
+    return out
 
 
 def parse_slo_rule(text: str) -> SloRule:
@@ -208,10 +242,28 @@ class Watchtower:
                  registry: Optional[Registry] = None,
                  max_incidents: int = 4096,
                  max_read_bytes: int = 32 * 2**20,
-                 derive_ticks: int = 1):
+                 derive_ticks: int = 1,
+                 perf_profile: Optional[Dict[str, Any]] = None,
+                 perf_ratio: float = 2.0,
+                 perf_min_events: int = 20):
         self.targets = list(targets)
         self.gateways = list(gateways or [])
         self.rules = [parse_slo_rule(s) for s in slos]
+        # the perf-drift sentinel: a frozen {segment: mean_s} baseline
+        # arms a built-in per-node perf_drift_ratio ceiling rule that
+        # rides the same hysteresis + episode machinery as every SLO
+        self.perf_profile = (normalize_perf_profile(perf_profile)
+                             if perf_profile else None)
+        self.perf_ratio = float(perf_ratio)
+        self.perf_min_events = max(1, int(perf_min_events))
+        if self.perf_profile:
+            self.rules.append(
+                SloRule("perf_drift_ratio", "<=", self.perf_ratio))
+        # previous scrape's pump-segment series per target (the drift
+        # signal is a scrape-to-scrape delta, never the cumulative
+        # totals — startup cost must not poison steady-state means);
+        # bounded: one small filtered dict per target
+        self._prev_segments: Dict[str, dict] = {}
         self.engage_ticks = max(1, engage_ticks)
         self.clear_ticks = max(1, clear_ticks)
         self.window = window
@@ -357,6 +409,9 @@ class Watchtower:
             values[("degrade_active", name)] = float(
                 1 if (hd.get("degrade") or {}).get("active")
                 or (st.get("degraded") or {}).get("active") else 0)
+            drift = self._perf_drift(name, snap.get("metrics") or {})
+            if drift is not None:
+                values[("perf_drift_ratio", name)] = drift
             self._ring(name, "chain_len").push(
                 now, float(chain_lens.get(name, 0)))
         # cluster signals
@@ -388,6 +443,32 @@ class Watchtower:
         if not by_le:
             return None
         return histogram_quantile(sorted(by_le.items()), 0.99)
+
+    def _perf_drift(self, name: str,
+                    metrics: Dict[str, Any]) -> Optional[float]:
+        """Worst live/profile per-segment mean-cost ratio for one node
+        this tick, or None (no profile armed, first scrape of the
+        target, or no profiled segment saw ``perf_min_events`` events
+        since the last scrape).  Ratios come from scrape-to-scrape
+        deltas so the signal tracks what the node is doing NOW."""
+        if not self.perf_profile:
+            return None
+        keys = ("hbbft_pump_segment_seconds_sum",
+                "hbbft_pump_segment_seconds_count")
+        cur = {k: metrics.get(k, []) for k in keys}
+        prev = self._prev_segments.get(name)
+        self._prev_segments[name] = cur
+        if prev is None:
+            return None
+        worst: Optional[float] = None
+        for seg, live in segment_means(cur, prev).items():
+            base = self.perf_profile.get(seg)
+            if base is None or live["events"] < self.perf_min_events:
+                continue
+            ratio = live["mean_s"] / base
+            if worst is None or ratio > worst:
+                worst = ratio
+        return worst
 
     # -- incident plumbing ---------------------------------------------------
 
@@ -455,6 +536,8 @@ class Watchtower:
                     kind = ("target_down"
                             if rule.signal == "target_up" else
                             "straggler" if rule.signal == "epoch_lag"
+                            else "perf_regression"
+                            if rule.signal == "perf_drift_ratio"
                             else f"slo_{rule.signal}")
                     self._raise_incident(
                         now, kind, "warn", subject,
@@ -604,6 +687,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--clear-ticks", type=int, default=2)
     ap.add_argument("--scrape-workers", type=int, default=8)
     ap.add_argument("--scrape-timeout", type=float, default=2.0)
+    ap.add_argument("--perf-profile", default="",
+                    metavar="PATH",
+                    help="frozen per-segment cost profile (JSON from "
+                         "bench.py --freeze-perf-profile); arms the "
+                         "perf-drift sentinel")
+    ap.add_argument("--perf-ratio", type=float, default=2.0,
+                    help="live/profile mean-cost ratio ceiling before "
+                         "a perf_regression incident (default 2.0)")
     ap.add_argument("--journal-out", default="",
                     help="directory for the watchtower's own incident "
                          "journal (HealthIncident records)")
@@ -641,13 +732,18 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         recorder = FlightRecorder(args.journal_out, "watchtower",
                                   flavor="watch", clock=_time.time)
+    profile = None
+    if args.perf_profile:
+        with open(args.perf_profile, encoding="utf-8") as fh:
+            profile = json.load(fh)
     watch = Watchtower(
         targets, gw_targets, journal_roots=roots or None,
         slos=tuple(DEFAULT_SLOS) + tuple(args.slo),
         engage_ticks=args.engage_ticks, clear_ticks=args.clear_ticks,
         scrape_workers=args.scrape_workers,
         scrape_timeout_s=args.scrape_timeout,
-        recorder=recorder)
+        recorder=recorder,
+        perf_profile=profile, perf_ratio=args.perf_ratio)
     if args.serve_port:
         addr = _serve_health(watch, "127.0.0.1", args.serve_port)
         print(f"watch: serving /health on {addr}", file=sys.stderr)

@@ -597,6 +597,16 @@ def _lazy_register():
                          + s(m.detail)),
               lambda r: HealthIncident(r.u64(), r.f64(), rs(r), rs(r),
                                        rs(r), rs(r), rs(r), rs(r)))
+    # performance-plane sampling window (obs/flight.py, emitted by
+    # obs/perf.py through the flight recorder) -------------------------------
+    from hbbft_tpu.obs.flight import PerfSnapshot
+
+    _register(0x97, PerfSnapshot,
+              lambda m: (u64(m.seq) + f64(m.t) + s(m.source)
+                         + f64(m.window_s) + f64(m.cpu_frac)
+                         + f64(m.headroom) + s(m.doc)),
+              lambda r: PerfSnapshot(r.u64(), r.f64(), rs(r), r.f64(),
+                                     r.f64(), r.f64(), rs(r)))
 
 
 def ensure_registered():

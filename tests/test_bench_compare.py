@@ -215,3 +215,53 @@ def test_multichip_trajectory_gates_per_device_count():
     report = bench.compare_bench(old, bad, threshold=0.15)
     assert not report["ok"]
     assert "trajectory[4dev].epochs_per_s" in report["regressions"]
+
+
+def test_pump_segment_means_gate_equal_depth_and_shape_only():
+    """The perf plane's per-segment pump costs gate like the phase
+    attribution: lower-better mean seconds at 2x threshold, compared
+    only at equal pipeline depth and only for segments present in BOTH
+    recordings."""
+    old = _line()
+    old["pump_util"] = {
+        "msg": {"mean_s": 0.001, "busy_s": 1.0, "events": 1000},
+        "deferred": {"mean_s": 0.004, "busy_s": 0.4, "events": 100},
+        "guard": {"mean_s": 0.0002, "busy_s": 0.02, "events": 100},
+    }
+    new = _line()
+    new["pump_util"] = {
+        "msg": {"mean_s": 0.0025, "busy_s": 2.5, "events": 1000},
+        "deferred": {"mean_s": 0.0042, "busy_s": 0.42, "events": 100},
+        "shed": {"mean_s": 0.001, "busy_s": 0.1, "events": 100},
+    }
+    report = bench.compare_bench(old, new, threshold=0.15)
+    # msg 2.5x the old mean fails the 2x-threshold (30%) gate;
+    # deferred +5% is noise; guard/shed exist on one side only
+    assert report["regressions"] == ["pump[msg].mean_s"]
+    check = [c for c in report["checks"]
+             if c["name"] == "pump[msg].mean_s"][0]
+    assert check["threshold_pct"] == 30.0 and check["delta_pct"] == 150.0
+    names = {c["name"] for c in report["checks"]}
+    assert "pump[deferred].mean_s" in names
+    assert not any("guard" in n or "shed" in n for n in names)
+
+    # a faster segment (lower mean) never regresses
+    faster = _line()
+    faster["pump_util"] = {
+        "msg": {"mean_s": 0.0004, "busy_s": 0.4, "events": 1000}}
+    assert bench.compare_bench(old, faster, threshold=0.15)["ok"]
+
+    # a depth change skips the pump gate entirely: per-iteration work
+    # legitimately differs once epochs overlap
+    deeper = _line(value=40.0)
+    deeper["pipeline_depth"] = 4
+    deeper["pump_util"] = {
+        "msg": {"mean_s": 0.005, "busy_s": 5.0, "events": 1000}}
+    report = bench.compare_bench(old, deeper, threshold=0.15)
+    assert report["ok"]
+    assert not any(c["name"].startswith("pump[")
+                   for c in report["checks"])
+
+    # pre-perf-plane recordings (no pump_util key) compare trivially
+    assert bench.compare_bench(old, _line(), threshold=0.15)["ok"]
+    assert bench.compare_bench(_line(), new, threshold=0.15)["ok"]

@@ -168,6 +168,248 @@ def test_degrade_opt_out_and_custom_knobs():
     assert rt_knobs.degrade.max_level == 1
 
 
+def test_grow_doubles_per_boost_with_ceiling():
+    grow = DegradationController.grow
+    assert grow(32, 0, 256) == 32
+    assert grow(32, 1, 256) == 64
+    assert grow(32, 3, 256) == 256
+    assert grow(32, 5, 256) == 256   # capped, never past the ceiling
+    assert grow(300, 1, 256) == 256  # base above ceiling: ceiling wins
+
+
+def _raise_controller(**kwargs):
+    """A controller with the raise arm wired to scripted headroom and
+    demand signals (clean guard counters unless the test adds count)."""
+    hr = [0.9]
+    dem = [5.0]
+    defaults = dict(
+        max_boost=2, raise_windows=3, raise_headroom=0.6,
+        headroom_fn=lambda: hr[0], demand_fn=lambda: dem[0],
+    )
+    defaults.update(kwargs)
+    ctl, clock, count, applied = _controller(**defaults)
+    return ctl, clock, count, applied, hr, dem
+
+
+def test_raise_engages_only_after_raise_windows():
+    ctl, clock, count, applied, hr, dem = _raise_controller()
+
+    # two clean slack windows: not enough (raise_windows=3)
+    for t in (1.0, 2.0):
+        clock[0] = t
+        ctl.tick()
+        assert ctl.boost == 0 and applied == []
+    clock[0] = 3.0
+    ctl.tick()
+    assert ctl.boost == 1 and applied == [-1]
+
+    # the slack count restarts per boost level: three more windows
+    for t in (4.0, 5.0):
+        clock[0] = t
+        ctl.tick()
+        assert ctl.boost == 1
+    clock[0] = 6.0
+    ctl.tick()
+    assert ctl.boost == 2 and applied == [-1, -2]
+
+    # capped at max_boost: further slack windows change nothing
+    for t in (7.0, 8.0, 9.0, 10.0):
+        clock[0] = t
+        ctl.tick()
+    assert ctl.boost == 2 and applied == [-1, -2]
+    assert ctl._c_ctrl_transitions.value(direction="raise") == 2
+
+    d = ctl.as_dict()
+    assert d["boost"] == 2 and d["max_boost"] == 2
+    assert d["headroom"] == 0.9
+
+
+def test_raise_needs_real_headroom_and_real_demand():
+    ctl, clock, count, applied, hr, dem = _raise_controller(
+        raise_windows=2)
+
+    # headroom below the bar: strain, never slack
+    hr[0] = 0.3
+    for t in (1.0, 2.0, 3.0):
+        clock[0] = t
+        ctl.tick()
+    assert ctl.boost == 0 and applied == []
+
+    # headroom fine but no demand: quiet, never slack (an idle node
+    # has nothing to absorb)
+    hr[0] = 0.9
+    dem[0] = 0.0
+    for t in (4.0, 5.0, 6.0):
+        clock[0] = t
+        ctl.tick()
+    assert ctl.boost == 0 and applied == []
+
+    # a None headroom (perf plane not yet primed) is "no evidence of
+    # slack", not slack
+    dem[0] = 5.0
+    hr[0] = None
+    for t in (7.0, 8.0, 9.0):
+        clock[0] = t
+        ctl.tick()
+    assert ctl.boost == 0 and applied == []
+
+    # both real: raise after raise_windows
+    hr[0] = 0.9
+    clock[0] = 10.0
+    ctl.tick()
+    clock[0] = 11.0
+    ctl.tick()
+    assert ctl.boost == 1 and applied == [-1]
+
+
+def test_raise_arm_disabled_without_headroom_source_or_max_boost():
+    # max_boost left at its 0 default: headroom/demand alone never raise
+    hr = [0.95]
+    ctl, clock, count, applied = _controller(
+        headroom_fn=lambda: hr[0], demand_fn=lambda: 50.0,
+        raise_windows=1)
+    for t in (1.0, 2.0, 3.0, 4.0):
+        clock[0] = t
+        ctl.tick()
+    assert ctl.boost == 0 and applied == []
+
+    # max_boost set but no headroom source: a controller without a perf
+    # plane behind it must never infer slack
+    ctl, clock, count, applied = _controller(
+        max_boost=2, raise_windows=1, demand_fn=lambda: 50.0)
+    for t in (1.0, 2.0, 3.0, 4.0):
+        clock[0] = t
+        ctl.tick()
+    assert ctl.boost == 0 and applied == []
+
+
+def test_abuse_instantly_preempts_raised_level():
+    """PR-15 abuse-only rule stands: one abusive window restores the
+    exact bases FIRST, then the degradation ladder engages — the raised
+    state never coexists with pressure."""
+    ctl, clock, count, applied, hr, dem = _raise_controller(
+        raise_windows=2)
+    clock[0] = 1.0
+    ctl.tick()
+    clock[0] = 2.0
+    ctl.tick()
+    assert ctl.boost == 1 and applied == [-1]
+
+    clock[0] = 3.0
+    count[0] += 10.0  # 10/s >= engage 5/s
+    ctl.tick()
+    assert ctl.boost == 0 and ctl.level == 1
+    assert applied == [-1, 0, 1]  # restore-to-base precedes the ladder
+    assert ctl._c_ctrl_transitions.value(direction="restore") == 1
+
+
+def test_middle_band_pressure_forfeits_boost_without_degrading():
+    ctl, clock, count, applied, hr, dem = _raise_controller(
+        raise_windows=2)
+    clock[0] = 1.0
+    ctl.tick()
+    clock[0] = 2.0
+    ctl.tick()
+    assert ctl.boost == 1
+
+    clock[0] = 3.0
+    count[0] += 3.0  # 3/s: above clear (1/s), below engage (5/s)
+    ctl.tick()
+    assert ctl.boost == 0 and ctl.level == 0
+    assert applied == [-1, 0]
+    assert ctl._c_ctrl_transitions.value(direction="restore") == 1
+
+
+def test_quiet_windows_restore_exact_bases_in_one_step():
+    ctl, clock, count, applied, hr, dem = _raise_controller(
+        raise_windows=2)
+    for t in (1.0, 2.0, 3.0, 4.0):
+        clock[0] = t
+        ctl.tick()
+    assert ctl.boost == 2 and applied == [-1, -2]
+
+    dem[0] = 0.0  # demand gone
+    clock[0] = 5.0
+    ctl.tick()
+    assert ctl.boost == 2  # one quiet window is not enough
+    clock[0] = 6.0
+    ctl.tick()
+    # straight to the bases (restore), not a one-level step down
+    assert ctl.boost == 0 and applied == [-1, -2, 0]
+    assert ctl._c_ctrl_transitions.value(direction="restore") == 1
+
+
+def test_strain_steps_boost_down_one_level_at_a_time():
+    ctl, clock, count, applied, hr, dem = _raise_controller(
+        raise_windows=2)
+    for t in (1.0, 2.0, 3.0, 4.0):
+        clock[0] = t
+        ctl.tick()
+    assert ctl.boost == 2
+
+    hr[0] = 0.2  # demand stays, headroom gone: strain
+    clock[0] = 5.0
+    ctl.tick()
+    assert ctl.boost == 2
+    clock[0] = 6.0
+    ctl.tick()
+    assert ctl.boost == 1 and applied == [-1, -2, -1]
+    clock[0] = 7.0
+    ctl.tick()
+    clock[0] = 8.0
+    ctl.tick()
+    assert ctl.boost == 0 and applied == [-1, -2, -1, 0]
+    assert ctl._c_ctrl_transitions.value(direction="lower") == 2
+
+
+def test_attach_runtime_raise_levers_grow_and_restore_exactly():
+    """attach_runtime's raise wiring: negative effective levels double
+    the real levers toward the attach-time ceilings (default 8x), the
+    slack signal is the perf plane's measured headroom, and boost 0
+    restores the exact configured bases."""
+    from hbbft_tpu.net.cluster import (
+        ClusterConfig, build_runtime, generate_infos,
+    )
+
+    cfg = ClusterConfig(n=4, seed=24, batch_size=32,
+                        max_tx_bytes=64 * 1024)
+    rt = build_runtime(cfg, generate_infos(cfg), 0,
+                       degrade_kwargs=dict(max_boost=2))
+    try:
+        ctl = rt.degrade
+        assert ctl.max_boost == 2
+        assert ctl.headroom_fn == rt.perf.headroom
+        algo = rt.sq.algo
+        base_batch = algo.batch_size
+        base_cap = rt.mempool.capacity
+        base_pending = rt.mempool.max_pending_bytes
+
+        ctl._set_boost(1, "raise", "test")
+        assert algo.batch_size == base_batch * 2
+        assert rt.mempool.capacity == base_cap * 2
+        assert rt.mempool.max_pending_bytes == base_pending * 2
+        ctl._set_boost(2, "raise", "test")
+        assert algo.batch_size == base_batch * 4
+
+        # the default ceiling is 8x the bases: boosts past it are capped
+        ctl.max_boost = 5
+        ctl._set_boost(5, "raise", "test")
+        assert algo.batch_size == base_batch * 8
+        assert rt.mempool.capacity == base_cap * 8
+
+        ctl._set_boost(0, "restore", "test")
+        assert algo.batch_size == base_batch
+        assert rt.mempool.capacity == base_cap
+        assert rt.mempool.max_pending_bytes == base_pending
+
+        doc = rt.status_doc()
+        assert doc["degraded"]["boost"] == 0
+        assert doc["degraded"]["base_batch_size"] == base_batch
+        assert doc["degraded"]["max_boost"] == 5
+    finally:
+        rt.transport.registry = None  # nothing started; nothing to stop
+
+
 @pytest.mark.slow
 def test_flood_shrinks_batch_then_restores_e2e():
     """The acceptance drill: a sustained garbage flood from a

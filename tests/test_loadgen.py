@@ -63,3 +63,66 @@ def test_open_loop_against_local_cluster():
     assert int(by_name["hbbft_load_committed_txs_total"].total()) \
         == report["committed_txs"]
     assert report["p50_ms"] > 0
+
+
+def test_build_schedule_deterministic_bounded_triangular():
+    """`--schedule` stages are a pure function of the seed: same seed →
+    identical stages (the closed-loop controller bench's replay
+    contract), jitter clamped inside [base, peak], directions forming
+    the up-then-down triangular ramp."""
+    from hbbft_tpu.net.loadgen import build_schedule
+
+    a = build_schedule(7)
+    assert a == build_schedule(7)
+    assert [s["stage"] for s in a] == list(range(6))
+    assert [s["direction"] for s in a] == ["up"] * 3 + ["down"] * 3
+    assert all(4 <= s["clients"] <= 32 for s in a)
+    assert all(s["waves"] == 2 for s in a)
+    # ramp endpoints touch the base, the crest reaches toward the peak
+    assert a[0]["clients"] == 4 and a[-1]["clients"] == 4
+    assert max(s["clients"] for s in a) >= 24
+    # a different seed jitters different points
+    assert build_schedule(8) != a
+
+    narrow = build_schedule(3, stages=4, base_clients=2,
+                            peak_clients=8, waves_per_client=5)
+    assert len(narrow) == 4
+    assert all(2 <= s["clients"] <= 8 and s["waves"] == 5
+               for s in narrow)
+
+    with pytest.raises(ValueError):
+        build_schedule(7, stages=0)
+    with pytest.raises(ValueError):
+        build_schedule(7, base_clients=8, peak_clients=4)
+
+
+def test_run_schedule_attaches_ctrl_probe_per_stage(monkeypatch):
+    """With a probe wired (`--max-boost`), every stage's summary
+    carries the controller scrape taken right after that stage's load —
+    the closed-loop evidence BENCH_CTRL records; without one, the
+    stages stay probe-free."""
+    from hbbft_tpu.net import loadgen
+
+    calls = []
+
+    def fake_run_load(addrs, cluster_id, shape):
+        calls.append((shape.clients, shape.burst_waves, shape.salt))
+        return {"offered_txs": 10, "committed_txs": 10, "shed_txs": 0,
+                "tx_per_s": 100.0, "wall_s": 0.1, "p50_ms": 1.0,
+                "p99_ms": 2.0}
+
+    monkeypatch.setattr(loadgen, "run_load", fake_run_load)
+    schedule = loadgen.build_schedule(7, stages=3, base_clients=2,
+                                      peak_clients=6)
+    shape = loadgen.LoadShape(tx_bytes=64, clients=1)
+    ticks = iter(range(100))
+    probe = lambda: [{"node": 0, "boost": next(ticks)}]  # noqa: E731
+    stages = loadgen.run_schedule([("h", 1)], b"cid", shape, schedule,
+                                  probe=probe)
+    assert [s["ctrl"][0]["boost"] for s in stages] == [0, 1, 2]
+    # each stage ran at its scheduled level with a disjoint dedup salt
+    assert [c[0] for c in calls] == [s["clients"] for s in schedule]
+    assert len({c[2] for c in calls}) == len(schedule)
+
+    bare = loadgen.run_schedule([("h", 1)], b"cid", shape, schedule)
+    assert all("ctrl" not in s for s in bare)

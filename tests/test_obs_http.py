@@ -18,6 +18,52 @@ from hbbft_tpu.obs.metrics import parse_prometheus_text
 TIMEOUT_S = 90
 
 
+def test_top_util_and_ctrl_cells_and_snapshot_doc():
+    """The perf-plane columns are pure functions of /status: util% is
+    ``100·(1 − headroom)``, ctrl is the signed effective level, and
+    both degrade to "-" on nodes without the respective plane."""
+    status = {
+        "perf": {"headroom": 0.25, "util": {"pump": 0.75}},
+        "degraded": {"level": 0, "boost": 1, "batch_size": 64,
+                     "base_batch_size": 32},
+    }
+    assert top.util_cell(status) == ("75", 75.0)
+    cell, doc = top.ctrl_summary(status)
+    assert cell == "-1"  # raised one boost level
+    assert doc == {"level": 0, "boost": 1, "effective": -1,
+                   "batch_size": 64, "base_batch_size": 32}
+
+    degraded = {"perf": {"headroom": 0.0},
+                "degraded": {"level": 2, "boost": 0, "batch_size": 8,
+                             "base_batch_size": 32}}
+    assert top.util_cell(degraded) == ("100", 100.0)
+    assert top.ctrl_summary(degraded)[0] == "+2"
+    at_base = {"headroom": 1.0,  # top-level fallback, sampler primed
+               "degraded": {"level": 1, "boost": 1, "batch_size": 32,
+                            "base_batch_size": 32}}
+    assert top.util_cell(at_base) == ("0", 0.0)
+    assert top.ctrl_summary(at_base)[0] == "0"
+    # no perf plane / no controller: "-" cells, None docs
+    assert top.util_cell({}) == ("-", None)
+    assert top.ctrl_summary({"degraded": None}) == ("-", None)
+
+    # the render table and --json doc carry the same cells
+    snap = {"status": dict(status, node=0, era=0, epoch=3,
+                           chain_len=3, batches=3, mempool=0,
+                           peers_connected=3, committed_txs=9,
+                           faults_observed=0, decode_failures=0,
+                           replay_gaps=0),
+            "metrics": {}, "health": {"status": "ok"}}
+    frame = top.render([("127.0.0.1", 9100)], [None], [snap], 1.0)
+    header = next(l for l in frame.splitlines() if "util%" in l)
+    assert "ctrl" in header
+    doc = top.snapshot_doc([("127.0.0.1", 9100)], [snap])
+    node = doc["nodes"][0]
+    assert node["util_pct"] == 75.0
+    assert node["ctrl"]["effective"] == -1
+    assert node["perf"]["headroom"] == 0.25
+
+
 def test_cluster_obs_endpoints_and_top():
     async def scenario():
         cfg = ClusterConfig(n=4, seed=23, batch_size=6)

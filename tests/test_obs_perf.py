@@ -1,0 +1,201 @@
+"""Performance plane (`obs/perf.py`).
+
+The always-on sampler contract: the core is clock-free (every window
+takes ``now`` from the caller), the first sample only primes, each
+window folds counter deltas into per-segment stats and per-layer
+utilization, the retained history is a bounded ring, every
+``snapshot_every``-th sample is journaled, and ``segment_means`` is the
+shared read path of the bench pump lines, frozen profiles, and the
+watchtower's perf-drift sentinel.
+"""
+
+import json
+
+import pytest
+
+from hbbft_tpu.obs.metrics import Registry
+from hbbft_tpu.obs.perf import (
+    ALL_LAYERS,
+    DEFAULT_ERASURE_REF_MBPS,
+    PUMP_SEGMENTS,
+    PerfPlane,
+    segment_means,
+)
+
+
+def _plane(**kwargs):
+    reg = Registry()
+    seg_h = reg.histogram("hbbft_pump_segment_seconds", "",
+                          labelnames=("segment",))
+    ph_h = reg.histogram("hbbft_phase_duration_seconds", "",
+                         labelnames=("phase",))
+    ers = reg.counter("hbbft_rbc_erasure_bytes_total", "")
+    sent = reg.counter("hbbft_net_bytes_sent_total", "")
+    return PerfPlane(reg, 0, **kwargs), seg_h, ph_h, ers, sent
+
+
+def test_priming_sample_then_window_folds_layer_utilization():
+    plane, seg_h, ph_h, ers, _sent = _plane()
+    assert plane.sample(10.0) is None  # priming: nothing to delta
+    assert plane.registry.get("hbbft_perf_headroom").value() == -1
+    assert plane.headroom() is None
+    assert plane.utilization() == {}
+    assert plane.summary()["headroom"] is None
+
+    # one 1 s window: 0.3 s pump (msg), 0.1 s recv, 0.05 s flush,
+    # 0.2 s crypto, 30 MB erasure (= 0.1 of the 300 MB/s reference)
+    for _ in range(60):
+        seg_h.labels(segment="msg").observe(0.005)
+    seg_h.labels(segment="recv").observe(0.1)
+    seg_h.labels(segment="flush").observe(0.05)
+    ph_h.labels(phase="decrypt_share").observe(0.2)
+    ers.inc(30e6)
+    w = plane.sample(11.0)
+    assert w is not None and w["wall_s"] == 1.0
+    assert abs(w["layers"]["pump"] - 0.3) < 1e-6
+    assert abs(w["layers"]["recv"] - 0.1) < 1e-6
+    assert abs(w["layers"]["egress"] - 0.05) < 1e-6
+    assert abs(w["layers"]["crypto"] - 0.2) < 1e-6
+    assert abs(w["layers"]["erasure"]
+               - 30e6 / (DEFAULT_ERASURE_REF_MBPS * 1e6)) < 1e-9
+    seg = w["segments"]["msg"]
+    assert seg["events"] == 60
+    assert abs(seg["mean_s"] - 0.005) < 1e-6
+    # headroom is 1 minus the WORST of the layer and whole-process
+    # CPU fractions, floored at 0
+    worst = max(max(w["layers"].values()), w["cpu_frac"])
+    assert abs(w["headroom"] - max(0.0, 1.0 - worst)) < 1e-12
+    assert plane.headroom() == w["headroom"]
+    assert plane.summary()["util"]["pump"] == round(w["layers"]["pump"], 4)
+    # the model's own exposition follows each window
+    reg = plane.registry
+    assert reg.get("hbbft_perf_headroom").value() == w["headroom"]
+    assert reg.get("hbbft_perf_util").value(layer="pump") \
+        == w["layers"]["pump"]
+    assert reg.get("hbbft_perf_util").value(layer="cpu") == w["cpu_frac"]
+    assert reg.get("hbbft_perf_samples_total").total() == 1
+
+
+def test_maybe_sample_is_rate_limited_and_ring_bounded():
+    plane, seg_h, *_ = _plane(interval_s=1.0, ring=5)
+    assert plane.maybe_sample(0.0) is None   # priming
+    assert plane.maybe_sample(0.5) is None   # inside the interval
+    assert plane._prev is not None
+    for i in range(1, 20):
+        seg_h.labels(segment="msg").observe(0.001)
+        plane.maybe_sample(float(i))
+    assert plane.samples == 19
+    assert len(plane.windows) == 5           # bounded ring
+    with pytest.raises(ValueError):
+        PerfPlane(Registry(), 0, interval_s=0.0)
+
+
+def test_every_nth_sample_is_journaled_via_record():
+    recorded = []
+    plane, seg_h, *_ = _plane(snapshot_every=3,
+                              record=lambda **kw: recorded.append(kw))
+    plane.sample(0.0)
+    for i in range(1, 8):
+        seg_h.labels(segment="msg").observe(0.002)
+        plane.sample(float(i))
+    assert len(recorded) == 2  # windows 3 and 6
+    assert recorded[0]["window_s"] == 1.0
+    assert 0.0 <= recorded[0]["headroom"] <= 1.0
+    doc = json.loads(recorded[0]["doc"])
+    assert set(doc) == {"layers", "segments"}
+    assert set(doc["layers"]) == set(ALL_LAYERS)
+    assert doc["segments"]["msg"]["events"] == 1
+
+
+def test_pump_cpu_and_offload_stats_fold_into_windows():
+    cpu = [0.0]
+    stats = [(0, 0)]
+    plane, *_ = _plane(pump_cpu_fn=lambda: cpu[0],
+                       pump_stats_fn=lambda: stats[0])
+    plane.sample(0.0)
+    cpu[0] = 0.4
+    stats[0] = (10, 3)
+    w = plane.sample(1.0)
+    assert abs(w["pump_cpu_frac"] - 0.4) < 1e-9
+    assert w["pump_iters"] == 10
+    assert abs(w["offload_frac"] - 0.3) < 1e-9
+
+
+def test_perf_doc_flame_tree_aggregates_the_ring():
+    plane, seg_h, *_ = _plane()
+    plane.sample(0.0)
+    seg_h.labels(segment="msg").observe(0.2)
+    seg_h.labels(segment="recv").observe(0.1)
+    plane.sample(1.0)
+    doc = plane.perf_doc()
+    assert doc["windows"] == 1 and doc["samples"] == 1
+    flame = doc["flame"]
+    assert flame["name"] == "node0"
+    by_name = {c["name"]: c for c in flame["children"]}
+    assert set(by_name) == set(ALL_LAYERS)
+    assert abs(by_name["pump"]["value"] - 0.2) < 1e-6
+    assert [c["name"] for c in by_name["pump"]["children"]] == ["msg"]
+    assert abs(by_name["recv"]["value"] - 0.1) < 1e-6
+    assert by_name["crypto"]["value"] == 0.0
+    assert doc["series"] == list(plane.windows)
+    assert doc["headroom"] == plane.headroom()
+
+
+def test_segment_means_folds_and_deltas_scrapes():
+    prev = {
+        "hbbft_pump_segment_seconds_sum":
+            [({"segment": "msg"}, 1.0), ({"segment": "input"}, 0.5)],
+        "hbbft_pump_segment_seconds_count":
+            [({"segment": "msg"}, 100.0), ({"segment": "input"}, 10.0)],
+    }
+    cur = {
+        "hbbft_pump_segment_seconds_sum":
+            [({"segment": "msg"}, 2.0), ({"segment": "input"}, 0.5)],
+        "hbbft_pump_segment_seconds_count":
+            [({"segment": "msg"}, 200.0), ({"segment": "input"}, 10.0)],
+    }
+    full = segment_means(cur)
+    assert full["msg"] == {"mean_s": 0.01, "busy_s": 2.0, "events": 200.0}
+    assert full["input"]["events"] == 10.0
+
+    d = segment_means(cur, prev)
+    assert d["msg"] == {"mean_s": 0.01, "busy_s": 1.0, "events": 100.0}
+    assert "input" not in d  # zero events in the delta window
+
+    # duplicate label rows (a multi-node fold) accumulate per segment
+    twice = {k: v + v for k, v in cur.items()}
+    assert segment_means(twice)["msg"]["events"] == 400.0
+    assert segment_means({}) == {}
+
+
+def test_runtime_folds_batch_msgs_into_msg_segment():
+    """The batch-handle transport delivers peer traffic as ``"msgs"``
+    pump events; their dispatch time must fold into the ``msg``
+    segment (one observation per iteration) — otherwise the dominant
+    hot path is invisible to the perf plane, the frozen profile, and
+    the drift sentinel."""
+    from hbbft_tpu.net.cluster import ClusterConfig, build_algo, \
+        generate_infos
+    from hbbft_tpu.net.runtime import NodeRuntime
+
+    cfg = ClusterConfig(n=4, seed=5)
+    infos = generate_infos(cfg)
+    rt = NodeRuntime(build_algo(cfg, infos, 0), cfg.cluster_id)
+    child = rt.registry.get(
+        "hbbft_pump_segment_seconds").labels(segment="msg")
+    junk = b"\x00perf-junk"  # undecodable: strikes the peer, no raise
+    rt.pump_process([("msgs", (1, [junk, junk])), ("msg", (1, junk))],
+                    depth=1)
+    assert child.count == 1 and child.sum > 0.0
+    rt.pump_process([("msgs", (2, [junk]))], depth=1)
+    assert child.count == 2
+
+
+def test_pump_segment_taxonomy_is_the_histogram_contract():
+    # the sampler's segment list must cover the pump's attribution
+    # taxonomy (runtime.py's histogram help string); queue_wait is
+    # latency (not busy time) and recv/flush are their own layers
+    assert set(PUMP_SEGMENTS) == {"msg", "input", "hello", "startup",
+                                  "guard", "shed", "deferred"}
+    assert "queue_wait" not in PUMP_SEGMENTS
+    assert "recv" not in PUMP_SEGMENTS and "flush" not in PUMP_SEGMENTS

@@ -35,6 +35,7 @@ from hbbft_tpu.obs.watch import (
     Ring,
     SloRule,
     Watchtower,
+    normalize_perf_profile,
     parse_slo_rule,
 )
 from hbbft_tpu.protocols.dynamic_honey_badger import DynamicHoneyBadger
@@ -330,6 +331,112 @@ def test_straggler_hysteresis_one_incident_per_episode():
         assert len(tower.incidents) == 2
     finally:
         tower.close()
+
+
+def test_normalize_perf_profile_accepts_frozen_doc_and_flat_forms():
+    frozen = {"segments": {"msg": {"mean_s": 0.001},
+                           "bogus": {"mean_s": "nan?"},
+                           "zero": {"mean_s": 0.0}},
+              "epochs_per_s": 12.0}
+    assert normalize_perf_profile(frozen) == {"msg": 0.001}
+    flat = {"msg": 0.002, "input": "junk", "neg": -1.0}
+    assert normalize_perf_profile(flat) == {"msg": 0.002}
+    assert normalize_perf_profile(None) == {}
+    assert normalize_perf_profile([1, 2]) == {}
+
+
+def _perf_snaps_factory(names, per_tick_events=50):
+    """Scripted scrapes whose pump-segment counters advance by
+    ``mean_s * events`` per tick — cumulative, like a real /metrics."""
+    cum = {n: [0.0, 0.0] for n in names}
+
+    def snaps(mean_by_name, events=per_tick_events):
+        out = {}
+        for n in names:
+            mean = mean_by_name.get(n, 0.001)
+            cum[n][0] += mean * events
+            cum[n][1] += events
+            s = _snap(20)
+            s["metrics"] = {
+                "hbbft_pump_segment_seconds_sum":
+                    [({"segment": "msg"}, cum[n][0])],
+                "hbbft_pump_segment_seconds_count":
+                    [({"segment": "msg"}, float(cum[n][1]))],
+            }
+            out[n] = s
+        return out
+
+    return snaps
+
+
+def test_perf_sentinel_one_incident_per_episode_zero_false_alarms():
+    """The perf-drift sentinel: live per-window segment means compared
+    against the frozen same-host profile through the standard SLO
+    hysteresis — a held 3x slowdown on one node alarms exactly once as
+    ``perf_regression``, clean scrapes at the profile never alarm, and
+    a second slowdown episode after a full clear alarms exactly once
+    more."""
+    names = _names(2)
+    snaps = _perf_snaps_factory(names)
+    tower = Watchtower(_targets(2),
+                       perf_profile={"segments":
+                                     {"msg": {"mean_s": 0.001}}},
+                       perf_ratio=2.0, perf_min_events=10,
+                       engage_ticks=2, clear_ticks=2)
+    try:
+        t = iter(range(100))
+        # clean: live means at the profile — zero alarms over many
+        # ticks (the first scrape only primes the per-target delta)
+        for _ in range(6):
+            assert tower.tick(float(next(t)), snaps=snaps({})) == []
+        # node 1's msg segment goes 3x the frozen mean, held
+        slow = {names[1]: 0.003}
+        raised = []
+        for _ in range(5):
+            raised.extend(tower.tick(float(next(t)), snaps=snaps(slow)))
+        assert [(i["kind"], i["subject"]) for i in raised] \
+            == [("perf_regression", names[1])]
+        # recovery clears the episode; a NEW slowdown alarms once more
+        for _ in range(3):
+            assert tower.tick(float(next(t)), snaps=snaps({})) == []
+        raised2 = []
+        for _ in range(4):
+            raised2.extend(tower.tick(float(next(t)),
+                                      snaps=snaps(slow)))
+        assert [i["kind"] for i in raised2] == ["perf_regression"]
+        assert len([i for i in tower.incidents
+                    if i["kind"] == "perf_regression"]) == 2
+    finally:
+        tower.close()
+
+
+def test_perf_sentinel_ignores_low_event_windows_and_unarmed_tower():
+    # below perf_min_events the drifted window is noise, not evidence
+    names = _names(2)
+    snaps = _perf_snaps_factory(names)
+    tower = Watchtower(_targets(2),
+                       perf_profile={"msg": 0.001},
+                       perf_ratio=2.0, perf_min_events=10,
+                       engage_ticks=2, clear_ticks=2)
+    try:
+        slow = {names[0]: 0.005}
+        for i in range(5):
+            assert tower.tick(float(i),
+                              snaps=snaps(slow, events=5)) == []
+    finally:
+        tower.close()
+
+    # no profile → the rule is never armed, drifted scrapes are ignored
+    snaps2 = _perf_snaps_factory(names)
+    bare = Watchtower(_targets(2), engage_ticks=2, clear_ticks=2)
+    try:
+        assert not any(r.signal == "perf_drift_ratio"
+                       for r in bare.rules)
+        for i in range(5):
+            assert bare.tick(float(i),
+                             snaps=snaps2({names[0]: 0.05})) == []
+    finally:
+        bare.close()
 
 
 def test_custom_cluster_slo_floor():

@@ -192,7 +192,7 @@ def test_live_dhb_traffic_roundtrips():
 def _flight_samples():
     from hbbft_tpu.obs.flight import (
         FlightCommit, FlightFault, FlightHello, FlightMsg, FlightNote,
-        FlightSpan, HealthIncident,
+        FlightSpan, HealthIncident, PerfSnapshot,
     )
 
     return [
@@ -208,6 +208,8 @@ def _flight_samples():
         HealthIncident(15, 15.0, "watchtower", "equivocation", "fault",
                        "3", "equivocation:3:MultipleReadys:slot",
                        "node 3 sent two Ready roots for one RBC slot"),
+        PerfSnapshot(16, 16.0, "2", 1.0, 0.42, 0.58,
+                     '{"layers": {"pump": 0.42}, "segments": {}}'),
         _trace_sample(),
     ]
 
